@@ -1,0 +1,69 @@
+"""Benchmark driver: synthetic 'tiny' model training step time on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+
+Baseline: the reference's published single-GPU (A100-80GB) step time for the
+synthetic Tiny model, global batch 65536, Adagrad: 24.433 ms
+(BASELINE.md / reference examples/benchmarks/synthetic_models/README.md:69).
+vs_baseline > 1 means faster than the reference.
+"""
+
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_embeddings_tpu.models.synthetic import (
+    SYNTHETIC_MODELS, SyntheticModel, InputGenerator)
+
+BASELINE_TINY_1GPU_MS = 24.433
+
+
+def main():
+    cfg = SYNTHETIC_MODELS["tiny"]
+    batch = 65536
+    model = SyntheticModel(cfg, mesh=None, distributed=True)
+
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optax.adagrad(0.01)
+    opt_state = opt.init(params)
+
+    gen = InputGenerator(cfg, batch, alpha=1.05, num_batches=4, seed=0)
+
+    @jax.jit
+    def train_step(params, opt_state, numerical, cats, labels):
+        loss, grads = jax.value_and_grad(model.loss_fn)(
+            params, numerical, cats, labels)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    # warmup / compile
+    numerical, cats, labels = gen[0]
+    params, opt_state, loss = train_step(params, opt_state, numerical, cats,
+                                         labels)
+    jax.block_until_ready(loss)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(iters):
+        numerical, cats, labels = gen[i % len(gen)]
+        params, opt_state, loss = train_step(params, opt_state, numerical,
+                                             cats, labels)
+    jax.block_until_ready(loss)
+    dt_ms = (time.perf_counter() - t0) / iters * 1e3
+
+    print(json.dumps({
+        "metric": "synthetic_tiny_step_time_batch65536_adagrad_1chip",
+        "value": round(dt_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(BASELINE_TINY_1GPU_MS / dt_ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""distributed_embeddings_tpu: TPU-native hybrid-parallel embedding framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+NVIDIA-Merlin/distributed-embeddings (reference: distributed_embeddings/__init__.py:17-27):
+model-parallel embedding tables sharded over a `jax.sharding.Mesh`, with the
+Horovod all-to-all exchange replaced by XLA collectives inside `shard_map`,
+and the CUDA lookup kernels replaced by XLA-native gather/segment-sum plus
+optional Pallas kernels.
+"""
+
+from distributed_embeddings_tpu.version import __version__
+
+from distributed_embeddings_tpu.ops.embedding_ops import (
+    embedding_lookup,
+    RaggedIds,
+    SparseIds,
+)
+from distributed_embeddings_tpu.layers.embedding import (
+    Embedding,
+    ConcatOneHotEmbedding,
+    IntegerLookup,
+)
+from distributed_embeddings_tpu.layers import dist_model_parallel
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    DistEmbeddingStrategy,
+    DistributedEmbedding,
+)
+
+__all__ = [
+    "__version__",
+    "embedding_lookup",
+    "RaggedIds",
+    "SparseIds",
+    "Embedding",
+    "ConcatOneHotEmbedding",
+    "IntegerLookup",
+    "dist_model_parallel",
+    "DistEmbeddingStrategy",
+    "DistributedEmbedding",
+]

@@ -1,0 +1,5 @@
+from distributed_embeddings_tpu.layers.embedding import (
+    Embedding,
+    ConcatOneHotEmbedding,
+    IntegerLookup,
+)

@@ -1,0 +1,4 @@
+from distributed_embeddings_tpu.models.dlrm import (
+    DLRM, dot_interact, dlrm_initializer, make_lr_schedule)
+from distributed_embeddings_tpu.models.synthetic import (
+    EmbeddingConfig, ModelConfig, SyntheticModel, SYNTHETIC_MODELS)

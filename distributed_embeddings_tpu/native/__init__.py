@@ -1,0 +1,5 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The shared object is built from hashmap.cpp + io.cpp by `make` in this
+directory; if missing, it is compiled on first use with g++ (loader.py).
+"""

@@ -1,0 +1,125 @@
+// Host-side open-addressing hash map for on-the-fly vocabulary building.
+//
+// TPU-native replacement for the reference's cuCollections static_map GPU
+// kernel (reference: cc/kernels/embedding_lookup_kernels.cu:383-516). TPUs
+// have no device-side dynamic hash table; the TPU-native design runs the
+// key->index mapping on the TPU-VM host (this library, called via ctypes)
+// and keeps the device side a plain gather. Matches reference semantics:
+// index 0 reserved for OOV, capacity = max_tokens + 1, per-key frequency
+// counts, 1.5x slot load factor.
+//
+// Build: g++ -O3 -shared -fPIC hashmap.cpp -o _det_native.so
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kEmpty = INT64_MIN;  // sentinel for empty slot
+
+struct IntegerLookupMap {
+  int64_t capacity;    // max distinct keys + 1 (index 0 = OOV)
+  int64_t num_slots;   // power of two >= 1.5 * capacity
+  int64_t mask;
+  int64_t size;        // number of inserted keys
+  std::vector<int64_t> slot_keys;
+  std::vector<int64_t> slot_vals;      // index assigned to the key
+  std::vector<int64_t> keys_by_index;  // reverse map: index-1 -> key
+  std::vector<int64_t> counts;         // per-index frequency (index 0 = OOV)
+
+  explicit IntegerLookupMap(int64_t cap) : capacity(cap), size(0) {
+    int64_t want = static_cast<int64_t>(cap * 3 / 2) + 2;
+    num_slots = 16;
+    while (num_slots < want) num_slots <<= 1;
+    mask = num_slots - 1;
+    slot_keys.assign(num_slots, kEmpty);
+    slot_vals.assign(num_slots, 0);
+    keys_by_index.reserve(capacity);
+    counts.assign(capacity, 0);
+  }
+
+  static inline uint64_t hash(int64_t key) {
+    // splitmix64 finalizer
+    uint64_t x = static_cast<uint64_t>(key) + 0x9e3779b97f4a7c15ull;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+    return x ^ (x >> 31);
+  }
+
+  inline int64_t find(int64_t key) const {
+    uint64_t h = hash(key) & mask;
+    while (true) {
+      int64_t k = slot_keys[h];
+      if (k == key) return slot_vals[h];
+      if (k == kEmpty) return -1;
+      h = (h + 1) & mask;
+    }
+  }
+
+  inline int64_t find_or_insert(int64_t key) {
+    uint64_t h = hash(key) & mask;
+    while (true) {
+      int64_t k = slot_keys[h];
+      if (k == key) return slot_vals[h];
+      if (k == kEmpty) {
+        if (size >= capacity - 1) return 0;  // table full -> OOV
+        int64_t idx = ++size;                // indices start at 1
+        slot_keys[h] = key;
+        slot_vals[h] = idx;
+        keys_by_index.push_back(key);
+        return idx;
+      }
+      h = (h + 1) & mask;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* il_create(int64_t capacity) { return new IntegerLookupMap(capacity); }
+
+void il_destroy(void* handle) {
+  delete static_cast<IntegerLookupMap*>(handle);
+}
+
+int64_t il_size(void* handle) {
+  return static_cast<IntegerLookupMap*>(handle)->size;
+}
+
+void il_lookup_or_insert(void* handle, const int64_t* keys, int64_t n,
+                         int64_t* out) {
+  auto* m = static_cast<IntegerLookupMap*>(handle);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t idx = m->find_or_insert(keys[i]);
+    out[i] = idx;
+    m->counts[idx] += 1;
+  }
+}
+
+void il_lookup(void* handle, const int64_t* keys, int64_t n, int64_t* out) {
+  auto* m = static_cast<IntegerLookupMap*>(handle);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t idx = m->find(keys[i]);
+    out[i] = idx < 0 ? 0 : idx;
+  }
+}
+
+// keys_out must have room for il_size() entries (index order, 1-based
+// indices: keys_out[i] is the key mapped to index i+1).
+void il_export_keys(void* handle, int64_t* keys_out) {
+  auto* m = static_cast<IntegerLookupMap*>(handle);
+  std::memcpy(keys_out, m->keys_by_index.data(),
+              sizeof(int64_t) * m->keys_by_index.size());
+}
+
+// counts_out must have room for capacity entries (index 0 = OOV count).
+void il_export_counts(void* handle, int64_t* counts_out) {
+  auto* m = static_cast<IntegerLookupMap*>(handle);
+  std::memcpy(counts_out, m->counts.data(), sizeof(int64_t) * m->capacity);
+}
+
+}  // extern "C"

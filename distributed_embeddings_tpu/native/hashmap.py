@@ -1,0 +1,56 @@
+"""ctypes wrapper for the native IntegerLookup hash map (hashmap.cpp)."""
+
+import numpy as np
+
+from distributed_embeddings_tpu.native import loader
+
+
+class NativeIntegerLookup:
+    """Host hash map: int64 keys -> contiguous indices (0 reserved for OOV).
+
+    Backend for layers.embedding.IntegerLookup — the TPU-VM-host replacement
+    for the reference's cuCollections GPU map (embedding_lookup_kernels.cu:383-516).
+    """
+
+    def __init__(self, capacity: int):
+        self._lib = loader.load()
+        self.capacity = int(capacity)
+        self._handle = self._lib.il_create(self.capacity)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.il_destroy(self._handle)
+                self._handle = None
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+    @property
+    def size(self) -> int:
+        return int(self._lib.il_size(self._handle))
+
+    def lookup_or_insert(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        out = np.empty(keys.shape, dtype=np.int64)
+        self._lib.il_lookup_or_insert(
+            self._handle, keys.ctypes.data, keys.size, out.ctypes.data)
+        return out
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        out = np.empty(keys.shape, dtype=np.int64)
+        self._lib.il_lookup(
+            self._handle, keys.ctypes.data, keys.size, out.ctypes.data)
+        return out
+
+    def keys_in_index_order(self):
+        n = self.size
+        out = np.empty((n,), dtype=np.int64)
+        if n:
+            self._lib.il_export_keys(self._handle, out.ctypes.data)
+        return out.tolist()
+
+    def counts(self) -> np.ndarray:
+        out = np.zeros((self.capacity,), dtype=np.int64)
+        self._lib.il_export_counts(self._handle, out.ctypes.data)
+        return out

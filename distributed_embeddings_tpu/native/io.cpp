@@ -1,0 +1,135 @@
+// Native data-loader primitives: positional reads + background prefetch.
+//
+// TPU-native equivalent of the reference's RawBinaryDataset host path
+// (reference: examples/dlrm/utils.py:231-266 — os.pread + single-thread
+// prefetch executor). A small C++ thread pool issues pread()s ahead of the
+// training step so the host input pipeline overlaps device compute.
+//
+// Built into _det_native.so together with hashmap.cpp.
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct ReadRequest {
+  int file;
+  int64_t offset;
+  int64_t size;
+  uint8_t* dst;
+  bool done = false;
+};
+
+struct Prefetcher {
+  std::vector<int> fds;
+  std::deque<ReadRequest*> queue;
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  std::vector<std::thread> workers;
+  bool stop = false;
+
+  explicit Prefetcher(const char** paths, int64_t n_files, int64_t n_threads) {
+    for (int64_t i = 0; i < n_files; ++i) {
+      fds.push_back(open(paths[i], O_RDONLY));
+    }
+    for (int64_t t = 0; t < n_threads; ++t) {
+      workers.emplace_back([this] { this->worker(); });
+    }
+  }
+
+  ~Prefetcher() {
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      stop = true;
+    }
+    cv_work.notify_all();
+    for (auto& t : workers) t.join();
+    for (int fd : fds) {
+      if (fd >= 0) close(fd);
+    }
+  }
+
+  void worker() {
+    while (true) {
+      ReadRequest* req = nullptr;
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        cv_work.wait(lock, [this] { return stop || !queue.empty(); });
+        if (stop && queue.empty()) return;
+        req = queue.front();
+        queue.pop_front();
+      }
+      int64_t got = 0;
+      while (got < req->size) {
+        ssize_t r = pread(fds[req->file], req->dst + got, req->size - got,
+                          req->offset + got);
+        if (r <= 0) break;
+        got += r;
+      }
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        req->done = true;
+      }
+      cv_done.notify_all();
+    }
+  }
+
+  ReadRequest* submit(int file, int64_t offset, int64_t size, uint8_t* dst) {
+    auto* req = new ReadRequest{file, offset, size, dst};
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      queue.push_back(req);
+    }
+    cv_work.notify_one();
+    return req;
+  }
+
+  void wait(ReadRequest* req) {
+    std::unique_lock<std::mutex> lock(mu);
+    cv_done.wait(lock, [req] { return req->done; });
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pf_create(const char** paths, int64_t n_files, int64_t n_threads) {
+  return new Prefetcher(paths, n_files, n_threads);
+}
+
+void pf_destroy(void* handle) { delete static_cast<Prefetcher*>(handle); }
+
+void* pf_submit(void* handle, int64_t file, int64_t offset, int64_t size,
+                void* dst) {
+  return static_cast<Prefetcher*>(handle)->submit(
+      static_cast<int>(file), offset, size, static_cast<uint8_t*>(dst));
+}
+
+void pf_wait(void* handle, void* request) {
+  auto* pf = static_cast<Prefetcher*>(handle);
+  auto* req = static_cast<ReadRequest*>(request);
+  pf->wait(req);
+  delete req;
+}
+
+// synchronous convenience read
+int64_t pf_read(void* handle, int64_t file, int64_t offset, int64_t size,
+                void* dst) {
+  auto* pf = static_cast<Prefetcher*>(handle);
+  auto* req = pf->submit(static_cast<int>(file), offset, size,
+                         static_cast<uint8_t*>(dst));
+  pf->wait(req);
+  delete req;
+  return size;
+}
+
+}  // extern "C"

@@ -1,0 +1,48 @@
+"""Build-on-demand ctypes loader for the native library."""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_LOCK = threading.Lock()
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "_det_native.so")
+
+
+def load():
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if not os.path.exists(_SO):
+            srcs = [os.path.join(_DIR, f) for f in ("hashmap.cpp", "io.cpp")]
+            cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-Wall",
+                   *srcs, "-o", _SO]
+            subprocess.run(cmd, check=True, capture_output=True)
+        lib = ctypes.CDLL(_SO)
+
+        i64 = ctypes.c_int64
+        p = ctypes.c_void_p
+        lib.il_create.restype = p
+        lib.il_create.argtypes = [i64]
+        lib.il_destroy.argtypes = [p]
+        lib.il_size.restype = i64
+        lib.il_size.argtypes = [p]
+        lib.il_lookup_or_insert.argtypes = [p, ctypes.c_void_p, i64, ctypes.c_void_p]
+        lib.il_lookup.argtypes = [p, ctypes.c_void_p, i64, ctypes.c_void_p]
+        lib.il_export_keys.argtypes = [p, ctypes.c_void_p]
+        lib.il_export_counts.argtypes = [p, ctypes.c_void_p]
+
+        lib.pf_create.restype = p
+        lib.pf_create.argtypes = [ctypes.POINTER(ctypes.c_char_p), i64, i64]
+        lib.pf_destroy.argtypes = [p]
+        lib.pf_submit.restype = p
+        lib.pf_submit.argtypes = [p, i64, i64, i64, ctypes.c_void_p]
+        lib.pf_wait.argtypes = [p, p]
+        lib.pf_read.restype = i64
+        lib.pf_read.argtypes = [p, i64, i64, i64, ctypes.c_void_p]
+
+        _LIB = lib
+        return _LIB

@@ -1,0 +1,6 @@
+from distributed_embeddings_tpu.ops.embedding_ops import (
+    embedding_lookup,
+    RaggedIds,
+    SparseIds,
+    row_to_split,
+)

@@ -1,0 +1,29 @@
+"""Mesh helpers.
+
+The reference's communication bootstrap is `hvd.init()` + one-GPU-per-process
+pinning (reference examples/dlrm/main.py:152-157). The TPU equivalent is a
+`jax.sharding.Mesh`: a single axis (default name "mp") plays both the
+data-parallel and model-parallel role, exactly like the reference where
+dp ranks == mp ranks (dist_model_parallel.py:757-762). Multi-host pods just
+need `jax.distributed.initialize()` before building the mesh; the collectives
+ride ICI within a slice and DCN across slices based on device order.
+"""
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+DEFAULT_AXIS = "mp"
+
+
+def create_mesh(devices: Optional[Sequence] = None, axis_name: str = DEFAULT_AXIS) -> Mesh:
+    """Create a 1-D mesh over the given (default: all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def default_mesh(axis_name: str = DEFAULT_AXIS) -> Mesh:
+    return create_mesh(axis_name=axis_name)

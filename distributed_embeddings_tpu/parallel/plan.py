@@ -1,0 +1,204 @@
+"""SPMD lowering: planner output -> stacked, mesh-shardable parameterization.
+
+The reference runtime materializes a *different* set of Keras layers on every
+rank (dist_model_parallel.py:788-818) — natural for MPI, impossible for SPMD,
+where every device must run the same program over same-shaped arrays. The
+TPU-native representation chosen here:
+
+  * Table-parallel group: all tables a rank owns with the same
+    (width, combiner, offload) are concat-fused into one tall table (the
+    reference does the same per-rank, :651-691). Fused tables are then padded
+    to the max row count across ranks and stacked into one array
+    ``[world, rows_max, width]`` sharded `P(axis)` — each device holds exactly
+    its own fused table. One such "bucket" exists per distinct
+    (width, combiner, offload) key.
+  * Per-device differences (which features a device owns, each feature's row
+    offset inside the fused table) are encoded as small integer constants
+    ``[world, f_max]`` indexed by `lax.axis_index` at runtime — device-uniform
+    program, device-varying data.
+  * Row-slice group: each table becomes ``[world, slice_rows_max, width]``
+    sharded on axis 0 (vocab sharding across *all* devices).
+  * Weight (de/re)assembly is driven by flat placement records rather than the
+    reference's chunked-allgather choreography (:1056-1137): on TPU, global
+    weights are read/written through jax.Array shards directly.
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributed_embeddings_tpu.parallel.planner import DistEmbeddingStrategy
+
+Config = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TPSlot:
+    """One (device, bucket) lookup slot serving one table-parallel input."""
+    tp_input: int     # index within the tp input group
+    row_offset: int   # row offset of the backing table inside the fused bucket table
+
+
+@dataclasses.dataclass(frozen=True)
+class TPPlacement:
+    """Where one column-slice of one tp table physically lives. Drives
+    get/set_weights (reference get_col_sliced_weights :1056-1137)."""
+    table_id: int     # index within the col (tp) table group
+    rank: int
+    bucket: int
+    row_offset: int
+    rows: int
+    col_start: int
+    col_end: int
+
+
+@dataclasses.dataclass
+class TPBucket:
+    """One stacked parameter [world, rows_max, width]."""
+    width: int
+    combiner: Optional[str]
+    offload: bool
+    rows: List[int]                 # true (unpadded) rows per rank
+    rows_max: int
+    slots: List[List[TPSlot]]       # per rank, in exchange slot order
+    f_max: int
+    # [world, f_max] int32 constants (pad slots -> feature 0 / offset 0)
+    feature_sel: np.ndarray
+    feature_offsets: np.ndarray
+    # per-rank list of (table_id, row_offset, rows, initializer, dtype)
+    init_segments: List[List[Tuple[int, int, int, Any, Any]]]
+
+
+@dataclasses.dataclass
+class RowTablePlan:
+    """One row-sliced (vocab-sharded) table [world, rows_max, width]."""
+    table_id: int                   # index within the row table group
+    width: int
+    combiner: Optional[str]
+    rows_per_rank: List[int]
+    rows_max: int
+    row_base: np.ndarray            # [world] global row base per rank
+    initializer: Any
+    dtype: Any
+
+
+@dataclasses.dataclass
+class ShardedPlan:
+    world_size: int
+    strategy: DistEmbeddingStrategy
+    tp_buckets: List[TPBucket]
+    tp_placements: List[TPPlacement]
+    # per tp input: its shard-feature slots in rank order:
+    # list of (rank, bucket_idx, slot_idx)
+    tp_input_slots: List[List[Tuple[int, int, int]]]
+    row_tables: List[RowTablePlan]
+
+
+def _bucket_key(config: Config) -> Tuple[int, Optional[str], bool]:
+    return (config["output_dim"], config.get("combiner"),
+            bool(config.get("cpu_offload", False)))
+
+
+def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
+    """Lower a planner result to the stacked SPMD plan."""
+    world = strategy.world_size
+
+    # ---------------- table-parallel buckets --------------------------------
+    bucket_index: Dict[Tuple, int] = {}
+    buckets: List[TPBucket] = []
+    placements: List[TPPlacement] = []
+
+    # running column cursor per tp table (col slices are consumed in rank
+    # order, matching the reference's rank-ordered weight slicing :921-936)
+    col_cursor: Dict[int, int] = {}
+    # per (rank, local_table_pos) -> (bucket_idx, row_offset)
+    local_pos_info: List[List[Tuple[int, int]]] = []
+
+    for rank in range(world):
+        table_ids = strategy.table_ids[rank] if strategy.table_ids else []
+        configs = (strategy.local_preconcat_configs[rank]
+                   if strategy.local_preconcat_configs else [])
+        rank_info = []
+        for table_id, cfg in zip(table_ids, configs):
+            key = _bucket_key(cfg)
+            if key not in bucket_index:
+                bucket_index[key] = len(buckets)
+                buckets.append(TPBucket(
+                    width=cfg["output_dim"], combiner=cfg.get("combiner"),
+                    offload=bool(cfg.get("cpu_offload", False)),
+                    rows=[0] * world, rows_max=0,
+                    slots=[[] for _ in range(world)], f_max=0,
+                    feature_sel=None, feature_offsets=None,
+                    init_segments=[[] for _ in range(world)]))
+            b = bucket_index[key]
+            bucket = buckets[b]
+            row_offset = bucket.rows[rank]
+            bucket.rows[rank] += cfg["input_dim"]
+            bucket.init_segments[rank].append(
+                (table_id, row_offset, cfg["input_dim"],
+                 cfg.get("embeddings_initializer", "uniform"),
+                 cfg.get("dtype")))
+            col_start = col_cursor.get(table_id, 0)
+            col_end = col_start + cfg["output_dim"]
+            col_cursor[table_id] = col_end
+            placements.append(TPPlacement(
+                table_id=table_id, rank=rank, bucket=b,
+                row_offset=row_offset, rows=cfg["input_dim"],
+                col_start=col_start, col_end=col_end))
+            rank_info.append((b, row_offset))
+        local_pos_info.append(rank_info)
+
+    for bucket in buckets:
+        bucket.rows_max = max(bucket.rows) if bucket.rows else 0
+
+    # ---------------- input slots -------------------------------------------
+    n_tp_inputs = len(strategy.input_groups[1]) if strategy.input_groups else 0
+    tp_input_slots: List[List[Tuple[int, int, int]]] = [[] for _ in range(n_tp_inputs)]
+    for rank in range(world):
+        if not strategy.table_ids:
+            break
+        # reproduce the reference's per-rank input enumeration order
+        # (tables outer, inputs inner — dist_model_parallel.py:414-419)
+        for local_pos, table_idx in enumerate(strategy.table_ids[rank]):
+            for inp_pos, mapped_idx in enumerate(strategy.map_groups[1]):
+                if table_idx == mapped_idx:
+                    b, row_offset = local_pos_info[rank][local_pos]
+                    bucket = buckets[b]
+                    slot_idx = len(bucket.slots[rank])
+                    bucket.slots[rank].append(
+                        TPSlot(tp_input=inp_pos, row_offset=row_offset))
+                    tp_input_slots[inp_pos].append((rank, b, slot_idx))
+
+    for bucket in buckets:
+        bucket.f_max = max((len(s) for s in bucket.slots), default=0)
+        sel = np.zeros((world, max(bucket.f_max, 1)), dtype=np.int32)
+        offs = np.zeros((world, max(bucket.f_max, 1)), dtype=np.int32)
+        for rank, slots in enumerate(bucket.slots):
+            for j, slot in enumerate(slots):
+                sel[rank, j] = slot.tp_input
+                offs[rank, j] = slot.row_offset
+        bucket.feature_sel = sel
+        bucket.feature_offsets = offs
+
+    # ---------------- row-sliced tables -------------------------------------
+    row_tables: List[RowTablePlan] = []
+    n_row_tables = len(strategy.table_groups[2])
+    for t in range(n_row_tables):
+        per_rank = [strategy.row_sliced_configs[r][t] for r in range(world)]
+        rows = [cfg["input_dim"] for cfg in per_rank]
+        # reference keeps negative offsets (add to id); we store the positive
+        # global base row of each rank's slice (subtract from id).
+        base = np.asarray([-strategy.row_inputs_offsets[r][t]
+                           for r in range(world)], dtype=np.int32)
+        cfg0 = per_rank[0]
+        row_tables.append(RowTablePlan(
+            table_id=t, width=cfg0["output_dim"], combiner=cfg0.get("combiner"),
+            rows_per_rank=rows, rows_max=max(rows), row_base=base,
+            initializer=cfg0.get("embeddings_initializer", "uniform"),
+            dtype=cfg0.get("dtype")))
+
+    return ShardedPlan(
+        world_size=world, strategy=strategy, tp_buckets=buckets,
+        tp_placements=placements, tp_input_slots=tp_input_slots,
+        row_tables=row_tables)

@@ -1,0 +1,84 @@
+"""Initializer registry.
+
+Plays the role of keras.initializers in the reference's config IR
+(reference embedding.py:96, dist_model_parallel.py:686-687): initializers are
+named specs (or callables) carried inside TableConfig so the planner can
+re-instantiate sliced/concatenated tables deterministically.
+
+An initializer is a callable ``(key, shape, dtype) -> jax.Array``.
+"""
+
+import math
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+InitializerSpec = Union[str, Callable]
+
+
+def _uniform(scale: float):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, minval=-scale, maxval=scale)
+    return init
+
+
+def _glorot_uniform(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = shape[0], shape[1]
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, minval=-limit, maxval=limit)
+
+
+def _zeros(key, shape, dtype=jnp.float32):
+    del key
+    return jnp.zeros(shape, dtype)
+
+
+def _ones(key, shape, dtype=jnp.float32):
+    del key
+    return jnp.ones(shape, dtype)
+
+
+def _normal(key, shape, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype) * 0.05
+
+
+_REGISTRY = {
+    # keras 'uniform'/'random_uniform' default is +-0.05
+    "uniform": _uniform(0.05),
+    "random_uniform": _uniform(0.05),
+    "glorot_uniform": _glorot_uniform,
+    "zeros": _zeros,
+    "ones": _ones,
+    "normal": _normal,
+    "random_normal": _normal,
+}
+
+
+def get_initializer(spec: InitializerSpec) -> Callable:
+    """Resolve a named or callable initializer spec."""
+    if callable(spec):
+        return spec
+    if isinstance(spec, str):
+        if spec not in _REGISTRY:
+            raise ValueError(f"Unknown initializer '{spec}'")
+        return _REGISTRY[spec]
+    raise TypeError(f"Initializer spec must be str or callable, got {type(spec)}")
+
+
+class ConcatInitializer:
+    """Initialize a row-concatenated (fused) table as if each sub-table had
+    been initialized independently — preserves shape-dependent behavior
+    (reference ConcatInitializer, dist_model_parallel.py:29-40)."""
+
+    def __init__(self, initializer: InitializerSpec, sizes: Sequence[int]):
+        self._initializer = get_initializer(initializer)
+        self.sizes = list(sizes)
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        keys = jax.random.split(key, len(self.sizes))
+        parts = [
+            self._initializer(k, (size, shape[1]), dtype)
+            for k, size in zip(keys, self.sizes)
+        ]
+        return jnp.concatenate(parts, axis=0)

@@ -1,0 +1,28 @@
+"""Test config: run JAX on 8 virtual CPU devices so the full multi-chip
+sharding story is exercised without a TPU pod (SURVEY.md §4 implication).
+
+Note: the environment may pre-import jax with a TPU platform selected (e.g.
+an `axon` sitecustomize), so setting env vars alone is not enough — the
+config must be forced post-import, before any backend is initialized.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# persistent compilation cache: CPU test compiles of grad-of-shard_map are
+# slow; cache them across pytest runs
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_det")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) >= 8, (
+    f"tests need 8 virtual CPU devices, got {jax.devices()}")

@@ -1,0 +1,166 @@
+"""Planner unit tests — pure python, no devices (SURVEY.md §7 step 2)."""
+
+import pytest
+
+from distributed_embeddings_tpu.layers.embedding import Embedding
+from distributed_embeddings_tpu.parallel.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.parallel.plan import lower_strategy
+
+
+def tables(*dims):
+    return [Embedding(v, w) for v, w in dims]
+
+
+def test_table_groups_thresholds():
+    embs = tables((10, 4), (100, 4), (10000, 4))
+    s = DistEmbeddingStrategy(embs, 4, data_parallel_threshold=50,
+                              row_slice_threshold=10000)
+    assert s.table_groups == [[0], [1], [2]]
+
+
+def test_no_thresholds_all_col():
+    embs = tables((10, 4), (100, 4), (10000, 4))
+    s = DistEmbeddingStrategy(embs, 4)
+    assert s.table_groups == [[], [0, 1, 2], []]
+
+
+def test_column_slice_pow2():
+    s = DistEmbeddingStrategy(tables((8, 8)), 8, column_slice_threshold=16)
+    # 64 elements, threshold 16 -> 4 slices of width 2
+    widths = [cfg["output_dim"] for rank in s.local_preconcat_configs
+              for cfg in rank]
+    assert sorted(widths) == [2, 2, 2, 2]
+
+
+def test_column_slice_remainder():
+    s = DistEmbeddingStrategy(tables((4, 7)), 4, column_slice_threshold=7)
+    widths = [cfg["output_dim"] for rank in s.local_preconcat_configs
+              for cfg in rank]
+    assert sorted(widths) == [1, 2, 2, 2]
+
+
+def test_column_slice_capped_by_width():
+    # table of width 2 can't be split into more than 2 slices
+    s = DistEmbeddingStrategy(tables((1000, 2)), 8, column_slice_threshold=10)
+    widths = [cfg["output_dim"] for rank in s.local_preconcat_configs
+              for cfg in rank]
+    assert sorted(widths) == [1, 1]
+
+
+def test_auto_slice_fewer_tables_than_workers():
+    # 2 tables, 4 workers: every worker must get at least one slice
+    s = DistEmbeddingStrategy(tables((64, 8), (64, 8)), 4)
+    assert all(len(r) >= 1 for r in s.local_preconcat_configs)
+
+
+def test_merge_slices_same_rank():
+    # 4 slices, 2 workers -> 2 slices per worker, re-merged into 1 config each
+    s = DistEmbeddingStrategy(tables((8, 8)), 2, column_slice_threshold=16)
+    for rank_cfgs in s.local_preconcat_configs:
+        assert len(rank_cfgs) == 1
+        assert rank_cfgs[0]["output_dim"] == 4
+
+
+def test_basic_round_robin():
+    s = DistEmbeddingStrategy(tables((10, 4), (11, 4), (12, 4), (13, 4)), 2,
+                              strategy="basic")
+    assert s.table_ids[0] == [0, 2]
+    assert s.table_ids[1] == [1, 3]
+
+
+def test_memory_balanced_even_counts():
+    embs = tables((10, 4), (20, 4), (30, 4), (40, 4), (50, 4), (60, 4),
+                  (70, 4), (80, 4))
+    s = DistEmbeddingStrategy(embs, 4, strategy="memory_balanced")
+    counts = [len(ids) for ids in s.table_ids]
+    assert counts == [2, 2, 2, 2]
+    sizes = [sum(embs[t].input_dim * embs[t].output_dim for t in ids)
+             for ids in s.table_ids]
+    assert max(sizes) - min(sizes) <= 120  # paired largest+smallest
+
+
+def test_memory_optimized_all_assigned():
+    embs = tables((10, 4), (200, 4), (30, 4), (400, 4), (55, 4))
+    s = DistEmbeddingStrategy(embs, 2, strategy="memory_optimized")
+    assigned = sorted(t for ids in s.table_ids for t in ids)
+    assert assigned == [0, 1, 2, 3, 4]
+
+
+def test_concat_fusion_same_width():
+    embs = tables((10, 4), (20, 4), (30, 4), (40, 4))
+    s = DistEmbeddingStrategy(embs, 2, strategy="basic")
+    # rank 0 gets tables 0, 2 (both width 4) -> fused into one config
+    assert len(s.local_configs[0]) == 1
+    assert s.local_configs[0][0]["input_dim"] == 40
+    assert s.local_input_offsets[0] == [0, 10]
+
+
+def test_concat_no_fusion_across_widths():
+    embs = [Embedding(10, 4), Embedding(20, 8), Embedding(30, 8),
+            Embedding(40, 8)]
+    s = DistEmbeddingStrategy(embs, 2, strategy="basic")
+    # rank 0 gets tables 0 (w4) and 2 (w8): different widths, no fusion
+    assert len(s.local_configs[0]) == 2
+
+
+def test_offload_flags_largest():
+    embs = tables((10, 4), (1000, 4), (20, 4))
+    s = DistEmbeddingStrategy(embs, 1, gpu_embedding_size=200)
+    flags = {cfg["input_dim"]: cfg["cpu_offload"]
+             for cfg in s.local_preconcat_configs[0]}
+    assert flags[1000] is True
+    assert flags[10] is False and flags[20] is False
+
+
+def test_row_slice_configs():
+    embs = tables((103, 4))
+    s = DistEmbeddingStrategy(embs, 4, row_slice_threshold=100)
+    assert s.table_groups[2] == [0]
+    rows = [s.row_sliced_configs[r][0]["input_dim"] for r in range(4)]
+    assert rows == [26, 26, 26, 25]
+    offs = [s.row_inputs_offsets[r][0] for r in range(4)]
+    assert offs == [0, -26, -52, -78]
+
+
+def test_shared_tables_input_map():
+    embs = tables((10, 4), (20, 4))
+    s = DistEmbeddingStrategy(embs, 2, input_table_map=[0, 1, 0])
+    assert s.map_groups[1] == [0, 1, 0]
+    plan = lower_strategy(s)
+    # input 0 and 2 both hit table 0: two slots somewhere
+    assert len(plan.tp_input_slots[0]) == 1
+    assert len(plan.tp_input_slots[2]) == 1
+
+
+def test_rev_group_ids_restore_order():
+    embs = tables((10, 4), (1000, 4), (100000, 4))
+    s = DistEmbeddingStrategy(embs, 2, data_parallel_threshold=50,
+                              row_slice_threshold=100000)
+    flat = s.input_groups[0] + s.input_groups[1] + s.input_groups[2]
+    restored = [flat[idx] for idx in s.rev_group_ids]
+    assert restored == [0, 1, 2]
+
+
+def test_lowered_plan_placements_cover_tables():
+    embs = tables((64, 8), (32, 8), (16, 4))
+    s = DistEmbeddingStrategy(embs, 4, column_slice_threshold=128)
+    plan = lower_strategy(s)
+    for t, emb in enumerate(embs):
+        places = [p for p in plan.tp_placements if p.table_id == t]
+        assert sum((p.col_end - p.col_start) * 1 for p in places) >= 0
+        total_cols = sorted((p.col_start, p.col_end) for p in places)
+        # col ranges tile [0, width) without gaps
+        assert total_cols[0][0] == 0
+        assert total_cols[-1][1] == emb.output_dim
+        for (a, b), (c, d) in zip(total_cols, total_cols[1:]):
+            assert b == c
+        for p in places:
+            assert p.rows == emb.input_dim
+
+
+def test_world1_single_rank():
+    embs = tables((10, 4), (20, 4))
+    s = DistEmbeddingStrategy(embs, 1, strategy="memory_balanced")
+    assert s.strategy == "basic"
+    assert len(s.table_ids) == 1
+    assert sorted(s.table_ids[0]) == [0, 1]
