@@ -256,6 +256,23 @@ class DistributedEmbedding:
             prepped.append(self._prepare_one(x, mh))
         return prepped
 
+    @staticmethod
+    def _pad_cols(p: _PreparedInput, k_target: int, need_w: bool, batch: int):
+        """Pad one prepared input's ids (and weights) to k_target columns;
+        synthesizes all-ones weights when needed. Shared by the dp-input and
+        mp-input stacking paths."""
+        ids = p.ids.astype(jnp.int32)
+        pad = k_target - p.k
+        if pad:
+            ids = jnp.pad(ids, ((0, 0), (0, pad)))
+        w = None
+        if need_w:
+            w = (p.weights if p.weights is not None
+                 else jnp.ones((batch, p.k), jnp.float32))
+            if pad:
+                w = jnp.pad(w, ((0, 0), (0, pad)))
+        return ids, w
+
     # -------------------------------------------------------------- forward
     def _my_index(self):
         if self.world_size == 1:
@@ -309,15 +326,25 @@ class DistributedEmbedding:
                 emb = jnp.take(table, ids_l, axis=0)               # [B, f, K, w]
                 w_l = jnp.take(g_w, sel, axis=1) if g_w is not None else None
                 out = _combine(emb, w_l, bucket.combiner)          # [B, f, wf]
-                if world > 1:
-                    blocal = out.shape[0] // world
-                    x = out.reshape((world, blocal) + out.shape[1:])
-                    ex = lax.all_to_all(x, self.axis, split_axis=0, concat_axis=0)
-                else:
-                    ex = out[None]
-                ex_list.append(ex)
+                ex_list.append(self._tp_bucket_exchange(out))
 
         # ---- row-sliced tables: all_gather ids, masked lookup, psum_scatter
+        row_outs = self._row_slice_local(row_params, row_in)
+        return dp_outs, ex_list, row_outs
+
+    def _tp_bucket_exchange(self, out: jax.Array) -> jax.Array:
+        """mp->dp movement of one bucket's outputs: [B, f, wf] ->
+        [world_src, B_l, f, wf] (reference hvd.alltoall :870-872)."""
+        world = self.world_size
+        if world > 1:
+            blocal = out.shape[0] // world
+            x = out.reshape((world, blocal) + out.shape[1:])
+            return lax.all_to_all(x, self.axis, split_axis=0, concat_axis=0)
+        return out[None]
+
+    def _row_slice_local(self, row_params, row_in):
+        world = self.world_size
+        strat = self.strategy
         row_outs = []
         for j, (ids, weights) in enumerate(row_in):
             t = strat.map_groups[2][j]
@@ -348,8 +375,7 @@ class DistributedEmbedding:
                 out = lax.psum_scatter(out, self.axis, scatter_dimension=0,
                                        tiled=True)
             row_outs.append(out)
-
-        return dp_outs, ex_list, row_outs
+        return row_outs
 
     def apply(self, params: dict, inputs: Sequence) -> List[jax.Array]:
         """Forward pass with data-parallel input.
@@ -386,17 +412,10 @@ class DistributedEmbedding:
             need_w = (any(p.weights is not None for p in tp_prep)
                       or any(p.k != k_max for p in tp_prep))
             id_cols, w_cols = [], []
-            for i, p in enumerate(tp_prep):
-                ids = p.ids.astype(jnp.int32)
-                pad = k_max - p.k
-                if pad:
-                    ids = jnp.pad(ids, ((0, 0), (0, pad)))
+            for p in tp_prep:
+                ids, w = self._pad_cols(p, k_max, need_w, batch)
                 id_cols.append(ids)
                 if need_w:
-                    w = (p.weights if p.weights is not None
-                         else jnp.ones((batch, p.k), jnp.float32))
-                    if pad:
-                        w = jnp.pad(w, ((0, 0), (0, pad)))
                     w_cols.append(w)
             tp_ids = jnp.stack(id_cols, axis=1)
             tp_w = jnp.stack(w_cols, axis=1) if need_w else None
@@ -440,9 +459,28 @@ class DistributedEmbedding:
             dp_final.append(self._restore_shape(out, p, cfg.get("combiner"),
                                                 cfg["output_dim"]))
 
+        tp_final = self._assemble_tp_outputs(ex_list, tp_prep, batch)
+
+        row_final = []
+        for j, out in enumerate(row_outs):
+            p = row_prep[j]
+            rt = self.plan.row_tables[strat.map_groups[2][j]]
+            row_final.append(self._restore_shape(out, p, rt.combiner, rt.width))
+
+        outputs = dp_final + tp_final + row_final
+        return [outputs[idx] for idx in strat.rev_group_ids]
+
+    def _assemble_tp_outputs(self, ex_list, tp_preps, batch) -> List[jax.Array]:
+        """Slice the exchanged bucket outputs back into per-input arrays:
+        reorder by slot, re-concat column slices (reference :876-886).
+
+        Args:
+          ex_list: per bucket [world_src, B, f_max, wf] global arrays.
+          tp_preps: _PreparedInput per tp-group input position.
+        """
+        strat = self.strategy
         tp_final = []
-        for i in range(len(tp_prep)):
-            p = tp_prep[i]
+        for i, p in enumerate(tp_preps):
             parts = []
             for (rank, b, f) in self.plan.tp_input_slots[i]:
                 bucket = self.plan.tp_buckets[b]
@@ -456,14 +494,120 @@ class DistributedEmbedding:
                 strat.table_groups[1][strat.map_groups[1][i]]]
             tp_final.append(self._restore_shape(out, p, cfg.get("combiner"),
                                                 out.shape[-1]))
+        return tp_final
 
-        row_final = []
-        for j, out in enumerate(row_outs):
-            p = row_prep[j]
-            rt = self.plan.row_tables[strat.map_groups[2][j]]
-            row_final.append(self._restore_shape(out, p, rt.combiner, rt.width))
+    def apply_mp(self, params: dict, inputs) -> List[jax.Array]:
+        """Forward pass with model-parallel input (dp_input=False).
 
-        outputs = dp_final + tp_final + row_final
+        The reference mp-input contract (:729-731, :846-851): each rank
+        receives ids at *global* batch size for exactly the features it owns,
+        in ``strategy.input_ids_list[rank]`` order, skipping the dp->mp
+        exchange (the data loader already reads feature-sharded data, see
+        models/data.py RawBinaryDataset).
+
+        Args:
+          params: pytree from `init`.
+          inputs: nested per-rank lists — ``inputs[r][j]`` feeds the j-th
+            local input of rank r (dense [B]/[B,k] ids, RaggedIds, SparseIds
+            or (ids, weights)). With world_size == 1 a flat list is accepted.
+
+        Returns:
+          One [B, width] array per input in original input order,
+          batch-sharded over the mesh.
+        """
+        if self.dp_input:
+            raise ValueError("This layer was built with dp_input=True; "
+                             "use apply() instead")
+        strat = self.strategy
+        world = self.world_size
+        if world == 1 and (not inputs or not isinstance(inputs[0], list)):
+            inputs = [list(inputs)]
+        if len(inputs) != world:
+            raise ValueError(
+                f"apply_mp expects {world} per-rank input lists, got {len(inputs)}")
+
+        prepped: List[List[_PreparedInput]] = []
+        rank_pos: List[dict] = []   # per rank: tp input pos -> local index
+        input_prep = {}             # tp input pos -> representative prep
+        for r in range(world):
+            ids_list = strat.input_ids_list[r] if strat.input_ids_list else []
+            if len(inputs[r]) != len(ids_list):
+                raise ValueError(
+                    f"rank {r}: expected {len(ids_list)} inputs "
+                    f"(features {ids_list}), got {len(inputs[r])}")
+            plist, pos = [], {}
+            for j, (x, inp_pos) in enumerate(zip(inputs[r], ids_list)):
+                orig = strat.input_groups[1][inp_pos]
+                mh = (self.input_max_hotness[orig]
+                      if self.input_max_hotness is not None else None)
+                p = self._prepare_one(x, mh)
+                plist.append(p)
+                pos[inp_pos] = j
+                input_prep.setdefault(inp_pos, p)
+            prepped.append(plist)
+            rank_pos.append(pos)
+        if not input_prep:
+            return []
+        batch = next(iter(input_prep.values())).ids.shape[0]
+        if world > 1 and batch % world != 0:
+            raise ValueError(
+                f"Global batch {batch} not divisible by device count {world}")
+
+        # stack per-bucket mp inputs: ids [world, B, f_max, k_b] (+ weights)
+        bucket_ids, bucket_w = [], []
+        for b, bucket in enumerate(self.plan.tp_buckets):
+            slot_preps = [input_prep[s.tp_input]
+                          for slots in bucket.slots for s in slots]
+            k_b = max((p.k for p in slot_preps), default=1)
+            need_w = any(p.weights is not None or p.k != k_b
+                         for p in slot_preps)
+            f_max = max(bucket.f_max, 1)
+            per_rank_ids, per_rank_w = [], []
+            for r in range(world):
+                cols_i, cols_w = [], []
+                for s in bucket.slots[r]:
+                    p = prepped[r][rank_pos[r][s.tp_input]]
+                    ids, w = self._pad_cols(p, k_b, need_w, batch)
+                    cols_i.append(ids)
+                    if need_w:
+                        cols_w.append(w)
+                while len(cols_i) < f_max:
+                    cols_i.append(jnp.zeros((batch, k_b), jnp.int32))
+                    if need_w:
+                        cols_w.append(jnp.zeros((batch, k_b), jnp.float32))
+                per_rank_ids.append(jnp.stack(cols_i, axis=1))  # [B, f, k]
+                if need_w:
+                    per_rank_w.append(jnp.stack(cols_w, axis=1))
+            bucket_ids.append(jnp.stack(per_rank_ids))          # [world, B, f, k]
+            bucket_w.append(jnp.stack(per_rank_w) if need_w else None)
+
+        def body(tp_params, bucket_ids, bucket_w):
+            ex_list = []
+            for b, bucket in enumerate(self.plan.tp_buckets):
+                ids_l = bucket_ids[b][0]                        # [B, f, k]
+                offs = self._device_const(bucket.feature_offsets)
+                ids_l = ids_l + offs[None, :, None].astype(ids_l.dtype)
+                emb = jnp.take(tp_params[b][0], ids_l, axis=0)  # [B, f, k, w]
+                w_l = bucket_w[b][0] if bucket_w[b] is not None else None
+                out = _combine(emb, w_l, bucket.combiner)       # [B, f, wf]
+                ex_list.append(self._tp_bucket_exchange(out))
+            return ex_list
+
+        if world > 1:
+            specs = lambda tree, spec: jax.tree.map(lambda _: spec, tree)
+            ex_list = jax.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(specs(params["tp"], P(self.axis)),
+                          specs(bucket_ids, P(self.axis)),
+                          specs(bucket_w, P(self.axis))),
+                out_specs=[P(None, self.axis)] * len(self.plan.tp_buckets),
+                check_vma=False,
+            )(params["tp"], bucket_ids, bucket_w)
+        else:
+            ex_list = body(params["tp"], bucket_ids, bucket_w)
+
+        tp_preps = [input_prep[i] for i in range(len(strat.input_groups[1]))]
+        outputs = self._assemble_tp_outputs(ex_list, tp_preps, batch)
         return [outputs[idx] for idx in strat.rev_group_ids]
 
     @staticmethod
@@ -478,7 +622,9 @@ class DistributedEmbedding:
         return out
 
     def __call__(self, params, inputs):
-        return self.apply(params, inputs)
+        if self.dp_input:
+            return self.apply(params, inputs)
+        return self.apply_mp(params, inputs)
 
     # --------------------------------------------------------- weights I/O
     def get_weights(self, params, all_ranks: bool = False) -> List[np.ndarray]:

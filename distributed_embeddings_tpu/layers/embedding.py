@@ -85,10 +85,31 @@ class Embedding:
             else:
                 out_shape = (-1,) + tuple(ids.shape[1:]) + (self.output_dim,)
             ids = ids.reshape(-1, ids.shape[-1])
-        out = embedding_ops.embedding_lookup(table, ids, combiner=self.combiner)
+        if (self.combiner is not None and ids.ndim == 2 and ids.shape[1] > 1
+                and self._pallas_enabled()):
+            from distributed_embeddings_tpu.ops import pallas_lookup
+            out = pallas_lookup.fused_embedding_lookup(
+                table, ids, combiner=self.combiner)
+        else:
+            out = embedding_ops.embedding_lookup(table, ids,
+                                                 combiner=self.combiner)
         if out_shape is not None:
             out = out.reshape(out_shape)
         return out
+
+    def _pallas_enabled(self) -> bool:
+        """Custom kernels compile only on real TPU; elsewhere the XLA path is
+        both the fallback and the numerics reference (interpret mode is for
+        tests, far too slow for training)."""
+        if not self.use_custom_kernel:
+            return False
+        try:
+            from distributed_embeddings_tpu.ops import pallas_lookup
+        except ImportError:  # pallas unavailable on this jax build
+            return False
+        if os.environ.get("DET_FORCE_PALLAS", "0") == "1":
+            return True
+        return pallas_lookup.is_tpu_backend()
 
     def compute_output_shape(self, input_shape):
         if self.combiner is None:

@@ -135,10 +135,16 @@ class DLRM:
 
     def apply(self, params: dict, numerical: jax.Array,
               categorical: Sequence[jax.Array]) -> jax.Array:
-        """Forward: [B, num_numerical] + per-feature id arrays -> [B, 1] logit."""
+        """Forward: [B, num_numerical] + categorical ids -> [B, 1] logit.
+
+        With dp_input=True `categorical` is one global-batch id array per
+        feature; with dp_input=False it is the nested per-rank form expected
+        by DistributedEmbedding.apply_mp (reference dp_input semantics,
+        dist_model_parallel.py:729-731).
+        """
         x = numerical.astype(self.compute_dtype)
         bottom = _mlp_apply(params["bottom_mlp"], x, final_activation=True)
-        emb_outs = self.embedding.apply(params["embedding"], list(categorical))
+        emb_outs = self.embedding(params["embedding"], list(categorical))
         emb_outs = [e.astype(self.compute_dtype) for e in emb_outs]
         interact = dot_interact(emb_outs, bottom).astype(self.compute_dtype)
         return _mlp_apply(params["top_mlp"], interact)

@@ -253,7 +253,9 @@ class SyntheticModel:
 
     def apply(self, params, numerical, cat_features):
         if self.distributed:
-            embs = self.embedding.apply(params["embedding"], list(cat_features))
+            # __call__ dispatches on dp_input: flat per-feature inputs for
+            # the dp path, nested per-rank lists for the mp path
+            embs = self.embedding(params["embedding"], list(cat_features))
         else:
             embs = [self.embedding_layers[t](params["embedding"][t], ids)
                     for t, ids in zip(self.table_map, cat_features)]

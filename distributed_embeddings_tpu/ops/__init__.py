@@ -4,3 +4,7 @@ from distributed_embeddings_tpu.ops.embedding_ops import (
     SparseIds,
     row_to_split,
 )
+
+# NOTE: pallas_lookup is intentionally NOT imported here — the Pallas kernels
+# are an optional TPU-only path, imported lazily by layers/embedding.py so the
+# rest of the package has no hard jax.experimental.pallas dependency.

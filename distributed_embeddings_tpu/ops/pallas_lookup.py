@@ -1,0 +1,247 @@
+"""Pallas TPU kernels: fused multi-hot embedding lookup-combine.
+
+TPU-native replacement for the reference's custom CUDA combiner kernels
+(reference: cc/kernels/embedding_lookup_kernels.cu:33-336 — warp-level CSR
+segment reduce with shared-memory index staging). The TPU design is shaped by
+different hardware: there is no warp shuffle, but there is a 128x128 MXU and
+explicit async DMA. Two kernels cover the vocab spectrum:
+
+  * ``_onehot_lookup`` (small vocab): the weighted combine
+    ``out[b] = sum_k w[b,k] * table[ids[b,k]]`` is algebraically
+    ``A @ table`` with ``A[b,v] = sum_k w[b,k] * [ids[b,k] == v]``.
+    The kernel builds each ``[tile_b, tile_v]`` slab of A on the fly in VMEM
+    (never materializing the [B, V] one-hot in HBM) and accumulates partial
+    matmuls on the MXU over vocab tiles. Lookup *is* a matmul on TPU.
+
+  * ``_dma_gather_lookup`` (large vocab): ids are scalar-prefetched into SMEM
+    (PrefetchScalarGridSpec), the table stays in HBM, and the kernel streams
+    the addressed rows VMEM-ward with double-buffered async DMA — one buffer
+    accumulates ``w[b,k] * row`` while the next hotness step's rows are in
+    flight. This is the moral equivalent of the CUDA kernel's smem staging +
+    register accumulation (.cu:33-107), with DMA latency instead of memory
+    coalescing as the thing being hidden.
+
+The backward is XLA-native scatter-add (static shapes, no D2H sync — the
+reference grad kernel's `num_unique_ids` D2H copy at .cu:665 is the failure
+mode this avoids), registered through ``jax.custom_vjp``.
+
+Inputs are the framework's canonical padded multi-hot form: ids [B, K] with
+arbitrary ids in padded slots, weights [B, K] carrying 0.0 there (and the
+mean normalization pre-applied — see ``fused_embedding_lookup``).
+"""
+
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Vocab size at or below which the MXU one-hot-matmul kernel is used.
+ONEHOT_MAX_VOCAB = 8192
+# The DMA kernel wants lane-aligned rows; others fall back to XLA.
+_LANE = 128
+
+
+def is_tpu_backend() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _interpret_default(interpret: Optional[bool]) -> bool:
+    # compiled on TPU; interpreter elsewhere (CPU tests)
+    if interpret is None:
+        return not is_tpu_backend()
+    return interpret
+
+
+# --------------------------------------------------------------------------
+# small-vocab kernel: one-hot matmul on the MXU
+# --------------------------------------------------------------------------
+def _onehot_kernel(ids_ref, w_ref, table_ref, out_ref, *, tile_v: int):
+    j = pl.program_id(1)
+    ids = ids_ref[:]                               # [tb, K] int32
+    w = w_ref[:]                                   # [tb, K] f32
+    tb = ids.shape[0]
+    v_iota = (jax.lax.broadcasted_iota(jnp.int32, (tb, tile_v), 1)
+              + j * tile_v)
+    a = jnp.zeros((tb, tile_v), jnp.float32)
+    for k in range(ids.shape[1]):                  # K is small and static
+        a = a + jnp.where(v_iota == ids[:, k:k + 1], w[:, k:k + 1], 0.0)
+    part = jnp.dot(a, table_ref[:].astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = part
+
+    @pl.when(j != 0)
+    def _():
+        out_ref[:] = out_ref[:] + part
+
+
+def _onehot_lookup(table: jax.Array, ids: jax.Array, weights: jax.Array,
+                   tile_b: int = 256, tile_v: int = 512,
+                   interpret: Optional[bool] = None) -> jax.Array:
+    batch, k = ids.shape
+    vocab, width = table.shape
+    tile_b = min(tile_b, max(8, batch))
+    pad_b = -batch % tile_b
+    if pad_b:
+        ids = jnp.pad(ids, ((0, pad_b), (0, 0)))
+        weights = jnp.pad(weights, ((0, pad_b), (0, 0)))
+    pad_v = -vocab % tile_v
+    if pad_v:
+        # zero-pad so OOB vocab tiles contribute exact zeros (never NaN*0)
+        table = jnp.pad(table, ((0, pad_v), (0, 0)))
+    grid = ((batch + pad_b) // tile_b, (vocab + pad_v) // tile_v)
+    out = pl.pallas_call(
+        functools.partial(_onehot_kernel, tile_v=tile_v),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_b, k), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_b, k), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_v, width), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile_b, width), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((batch + pad_b, width), jnp.float32),
+        interpret=_interpret_default(interpret),
+    )(ids.astype(jnp.int32), weights.astype(jnp.float32), table)
+    return out[:batch]
+
+
+# --------------------------------------------------------------------------
+# large-vocab kernel: scalar-prefetched ids + double-buffered row DMA
+# --------------------------------------------------------------------------
+def _dma_gather_kernel(ids_ref, w_ref, table_ref, out_ref, rows_ref, sems,
+                       *, tile_b: int, hot: int):
+    i = pl.program_id(0)
+    base = i * tile_b * hot                        # ids are [B*K] row-major
+
+    def row_copy(k, slot, t):
+        row = ids_ref[base + t * hot + k]
+        return pltpu.make_async_copy(
+            table_ref.at[row], rows_ref.at[slot, t], sems.at[slot, t])
+
+    def start_k(k, slot):
+        for t in range(tile_b):
+            row_copy(k, slot, t).start()
+
+    def wait_k(k, slot):
+        for t in range(tile_b):
+            row_copy(k, slot, t).wait()
+
+    start_k(0, 0)
+    for k in range(hot):
+        slot = k % 2
+        if k + 1 < hot:
+            start_k(k + 1, (k + 1) % 2)
+        wait_k(k, slot)
+        contrib = rows_ref[slot].astype(jnp.float32) * w_ref[:, k:k + 1]
+        if k == 0:
+            out_ref[:] = contrib
+        else:
+            out_ref[:] = out_ref[:] + contrib
+
+
+def _dma_gather_lookup(table: jax.Array, ids: jax.Array, weights: jax.Array,
+                       tile_b: int = 8,
+                       interpret: Optional[bool] = None) -> jax.Array:
+    batch, hot = ids.shape
+    _, width = table.shape
+    pad_b = -batch % tile_b
+    if pad_b:
+        ids = jnp.pad(ids, ((0, pad_b), (0, 0)))
+        weights = jnp.pad(weights, ((0, pad_b), (0, 0)))
+    n_tiles = (batch + pad_b) // tile_b
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile_b, hot), lambda i, ids_ref: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),      # table stays in HBM
+        ],
+        out_specs=pl.BlockSpec((tile_b, width), lambda i, ids_ref: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, tile_b, width), table.dtype),
+            pltpu.SemaphoreType.DMA((2, tile_b)),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_dma_gather_kernel, tile_b=tile_b, hot=hot),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch + pad_b, width), jnp.float32),
+        interpret=_interpret_default(interpret),
+    )(ids.reshape(-1).astype(jnp.int32), weights.astype(jnp.float32), table)
+    return out[:batch]
+
+
+# --------------------------------------------------------------------------
+# dispatch + autodiff
+# --------------------------------------------------------------------------
+def _fused_impl(params, ids, weights, interpret):
+    vocab, width = params.shape
+    if vocab <= ONEHOT_MAX_VOCAB:
+        return _onehot_lookup(params, ids, weights, interpret=interpret)
+    if width % _LANE == 0:
+        return _dma_gather_lookup(params, ids, weights, interpret=interpret)
+    # XLA fallback: gather + weighted reduce (still fused by XLA)
+    embs = jnp.take(params, ids, axis=0)
+    return jnp.einsum("bk,bkw->bw", weights.astype(embs.dtype),
+                      embs).astype(jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_lookup(params, ids, weights, interpret):
+    return _fused_impl(params, ids, weights, interpret)
+
+
+def _fused_fwd(params, ids, weights, interpret):
+    return _fused_impl(params, ids, weights, interpret), (params, ids, weights)
+
+
+def _fused_bwd(interpret, res, g):
+    params, ids, weights = res
+    flat_ids = ids.reshape(-1)
+    contrib = (weights[..., None].astype(g.dtype) * g[:, None, :]).reshape(
+        -1, g.shape[-1])
+    # dense-table scatter-add: static shapes, no sort/unique, no host sync
+    dtable = jnp.zeros_like(params).at[flat_ids].add(
+        contrib.astype(params.dtype))
+    rows = jnp.take(params, ids, axis=0).astype(g.dtype)
+    dweights = jnp.einsum("bkw,bw->bk", rows, g).astype(weights.dtype)
+    return dtable, None, dweights
+
+
+_fused_lookup.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_embedding_lookup(params: jax.Array, ids: jax.Array,
+                           weights: Optional[jax.Array] = None,
+                           combiner: str = "sum",
+                           interpret: Optional[bool] = None) -> jax.Array:
+    """Fused padded multi-hot lookup: [V,W] table, [B,K] ids -> [B,W].
+
+    weights [B, K] carry 0.0 in padded slots (None = all-ones). Mean is
+    handled by pre-normalizing weights so both kernels only ever compute a
+    weighted sum (matching the reference Combiner semantics, .cu:96-99).
+    Differentiable in params and weights.
+    """
+    if combiner not in ("sum", "mean"):
+        raise ValueError(f"Unsupported combiner {combiner}")
+    if weights is None:
+        weights = jnp.ones(ids.shape, jnp.float32)
+    if combiner == "mean":
+        denom = jnp.maximum(jnp.sum(weights, axis=1, keepdims=True), 1.0)
+        weights = weights / denom
+    # match XLA gather semantics (clamp OOB) so results don't depend on which
+    # kernel path ran; also keeps the DMA kernel from reading past the table
+    ids = jnp.clip(ids, 0, params.shape[0] - 1)
+    return _fused_lookup(params, ids, weights, interpret).astype(params.dtype)

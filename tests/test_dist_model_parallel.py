@@ -250,3 +250,115 @@ def test_jit_apply():
     for a, b in zip(ref, outs):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5,
                                    atol=1e-5)
+
+
+# ---------------------------------------------------------------- mp input
+def check_mp_equivalence(specs, world=8, input_table_map=None, seed=0,
+                         check_train=True, **dist_kwargs):
+    """Same equivalence check through the model-parallel input path
+    (reference dp_input=False): each rank gets global-batch ids for the
+    features it owns (strategy.input_ids_list order)."""
+    rng = np.random.RandomState(seed)
+    embeddings, combiners = [], []
+    for spec in specs:
+        v, w = spec[0], spec[1]
+        c = spec[2] if len(spec) > 2 else None
+        embeddings.append(Embedding(v, w, combiner=c))
+        combiners.append(c)
+    table_map = (list(input_table_map) if input_table_map
+                 else list(range(len(specs))))
+
+    inputs = []
+    for i, t in enumerate(table_map):
+        v, c = specs[t][0], combiners[t]
+        if c is None:
+            inputs.append(jnp.asarray(rng.randint(0, v, size=(BATCH,))))
+        else:
+            inputs.append(jnp.asarray(
+                rng.randint(0, v, size=(BATCH, 2 + (i % 3)))))
+    weights = [rng.randn(s[0], s[1]).astype(np.float32) * 0.1 for s in specs]
+
+    mesh = make_mesh(world) if world > 1 else None
+    dist = DistributedEmbedding(embeddings, mesh=mesh, dp_input=False,
+                                input_table_map=input_table_map,
+                                **dist_kwargs)
+    params = dist.set_weights(weights)
+
+    def to_mp(inps):
+        return [[inps[dist.strategy.input_groups[1][pos]] for pos in rank_ids]
+                for rank_ids in dist.strategy.input_ids_list]
+
+    ref_w = [jnp.asarray(w) for w in weights]
+    ref_outs = ref_apply(ref_w, inputs, table_map, combiners)
+    dist_outs = dist.apply_mp(params, to_mp(inputs))
+
+    assert len(ref_outs) == len(dist_outs)
+    for i, (a, b) in enumerate(zip(ref_outs, dist_outs)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5,
+                                   atol=1e-5, err_msg=f"mp output {i}")
+    if not check_train:
+        return dist, params
+
+    cots = [jnp.asarray(rng.randn(*o.shape).astype(np.float32))
+            for o in ref_outs]
+
+    def dist_loss(p):
+        outs = dist.apply_mp(p, to_mp(inputs))
+        return sum(jnp.vdot(o, c) for o, c in zip(outs, cots))
+
+    def ref_loss(ws):
+        outs = ref_apply(ws, inputs, table_map, combiners)
+        return sum(jnp.vdot(o, c) for o, c in zip(outs, cots))
+
+    dist_grads = jax.grad(dist_loss)(params)
+    new_params = jax.tree.map(lambda p, g: p - LR * g, params, dist_grads)
+    ref_grads = jax.grad(ref_loss)(ref_w)
+    new_ref = [w - LR * g for w, g in zip(ref_w, ref_grads)]
+    got = dist.get_weights(new_params)
+    for t, (a, b) in enumerate(zip(new_ref, got)):
+        np.testing.assert_allclose(b, np.asarray(a), rtol=1e-4, atol=1e-5,
+                                   err_msg=f"mp updated table {t}")
+    return dist, params
+
+
+def test_mp_input_basic():
+    check_mp_equivalence(ONE_HOT_8, strategy="basic")
+
+
+def test_mp_input_memory_balanced():
+    check_mp_equivalence(ONE_HOT_8, strategy="memory_balanced")
+
+
+def test_mp_input_column_slice():
+    # slices of one table land on several ranks -> the same feature's ids are
+    # fed on every owning rank (reference :846-851)
+    check_mp_equivalence(ONE_HOT_8, strategy="memory_balanced",
+                         column_slice_threshold=400)
+
+
+def test_mp_input_multihot():
+    specs = [(96, 8, "sum"), (50, 8, "mean"), (100, 16, "sum"),
+             (120, 8, "sum"), (60, 8, "mean"), (70, 8, None)]
+    check_mp_equivalence(specs, strategy="memory_balanced")
+
+
+def test_mp_input_shared_tables():
+    check_mp_equivalence([(96, 8), (50, 16)], input_table_map=[0, 1, 0, 1, 0])
+
+
+def test_mp_input_single_device_flat():
+    check_mp_equivalence(ONE_HOT_8[:4], world=1)
+
+
+def test_mp_call_dispatch():
+    mesh = make_mesh(8)
+    dist = DistributedEmbedding([Embedding(64, 8) for _ in range(8)],
+                                mesh=mesh, dp_input=False)
+    params = dist.set_weights(
+        [np.zeros((64, 8), np.float32) for _ in range(8)])
+    with pytest.raises(ValueError, match="dp_input=False"):
+        dist.apply(params, [jnp.zeros((BATCH,), jnp.int32)] * 8)
+    mp_inputs = [[jnp.zeros((BATCH,), jnp.int32) for _ in rank_ids]
+                 for rank_ids in dist.strategy.input_ids_list]
+    outs = dist(params, mp_inputs)
+    assert len(outs) == 8 and outs[0].shape == (BATCH, 8)
