@@ -207,7 +207,15 @@ class DistributedEmbedding:
         return params
 
     def param_shardings(self, mesh: Optional[Mesh] = None) -> dict:
-        """NamedSharding pytree matching `init` output — for pjit/device_put."""
+        """NamedSharding pytree matching `init` output — for pjit/device_put.
+
+        Offload status: buckets flagged by the planner's gpu_embedding_size
+        budget (reference _maybe_offload :449-476) are kept in separate
+        buckets so they can be placed/streamed independently; physical
+        pinned-host placement is not wired yet — as of jax 0.9, XLA's
+        memory-space propagation does not reach through shard_map bodies, so
+        host-resident tables cannot participate in the SPMD forward.
+        """
         mesh = mesh or self.mesh
         if mesh is None:
             raise ValueError("No mesh bound")
@@ -255,6 +263,17 @@ class DistributedEmbedding:
                   if self.input_max_hotness is not None else None)
             prepped.append(self._prepare_one(x, mh))
         return prepped
+
+    def _bucket_gather(self, table: jax.Array, ids_l: jax.Array,
+                       offload: bool) -> jax.Array:
+        """Local fused-table gather. `offload` marks buckets past the
+        gpu_embedding_size budget; a true host-side gather (only looked-up
+        rows crossing PCIe, the reference's /CPU:0 lookup :829-831) needs
+        jax.experimental.compute_on('device_host'), whose memory-space
+        propagation does not reach through shard_map as of jax 0.9 — so the
+        gather stays device-side for now."""
+        del offload
+        return jnp.take(table, ids_l, axis=0)
 
     @staticmethod
     def _pad_cols(p: _PreparedInput, k_target: int, need_w: bool, batch: int):
@@ -323,7 +342,7 @@ class DistributedEmbedding:
                 ids_l = jnp.take(g_ids, sel, axis=1)               # [B, f_max, K]
                 ids_l = ids_l + offs[None, :, None].astype(ids_l.dtype)
                 table = tp_params[b][0]                            # [rows_max, w]
-                emb = jnp.take(table, ids_l, axis=0)               # [B, f, K, w]
+                emb = self._bucket_gather(table, ids_l, bucket.offload)
                 w_l = jnp.take(g_w, sel, axis=1) if g_w is not None else None
                 out = _combine(emb, w_l, bucket.combiner)          # [B, f, wf]
                 ex_list.append(self._tp_bucket_exchange(out))
@@ -587,7 +606,8 @@ class DistributedEmbedding:
                 ids_l = bucket_ids[b][0]                        # [B, f, k]
                 offs = self._device_const(bucket.feature_offsets)
                 ids_l = ids_l + offs[None, :, None].astype(ids_l.dtype)
-                emb = jnp.take(tp_params[b][0], ids_l, axis=0)  # [B, f, k, w]
+                emb = self._bucket_gather(tp_params[b][0], ids_l,
+                                          bucket.offload)      # [B, f, k, w]
                 w_l = bucket_w[b][0] if bucket_w[b] is not None else None
                 out = _combine(emb, w_l, bucket.combiner)       # [B, f, wf]
                 ex_list.append(self._tp_bucket_exchange(out))

@@ -1,0 +1,137 @@
+"""Training integration: the reference's Horovod-patch layer, re-thought SPMD.
+
+The reference ships four Horovod integration shims
+(reference: distributed_embeddings/python/layers/dist_model_parallel.py:1217-1326):
+
+  * ``DistributedGradientTape`` (:1242) — patches Horovod's tape so
+    model-parallel variables (tagged ``var.de_local``) are excluded from the
+    allreduce while data-parallel grads are averaged.
+  * ``DistributedOptimizer`` (:1270) — same patch for the Keras-fit path.
+  * ``broadcast_variables`` (:1219) — initial DP weight sync that skips MP vars.
+  * ``BroadcastGlobalVariablesCallback`` (:1303) — Keras callback form.
+
+Under SPMD none of the patching is load-bearing: a jit-compiled train step
+over a Mesh computes gradients that automatically follow parameter shardings
+(MP-sharded grads stay device-local; replicated-param grads are psummed by the
+shard_map/pjit transpose), and every process builds identical initial weights
+from the same seed. The behavioral contract — "MP gradients never cross
+workers, DP gradients are averaged, one backward pass handles both" (:1242-1267)
+— is a property of sharded autodiff here, not of a wrapper.
+
+These classes therefore exist for API parity and for the places where a real
+action remains (multi-process weight sync from process-local state, gradient
+postprocessing hooks). They are thin, documented, and jit-compatible.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    broadcast_variables)
+
+__all__ = [
+    "DistributedGradientTape",
+    "DistributedOptimizer",
+    "BroadcastGlobalVariablesCallback",
+    "broadcast_variables",
+    "make_train_step",
+]
+
+
+class DistributedGradientTape:
+    """API-parity shim for the reference DistributedGradientTape (:1242).
+
+    Usage: ``tape = DistributedGradientTape(); loss, grads =
+    tape.gradient(loss_fn, params, *args)``. The heavy lifting the reference
+    wrapper did (allreduce DP grads, keep MP grads local, sparse_as_dense) is
+    inherent to sharded autodiff — grads follow param shardings.
+    """
+
+    def __init__(self, sparse_as_dense: bool = True):
+        # sparse_as_dense is vacuous: XLA grads of gather are dense
+        # scatter-adds already (no IndexedSlices analogue in JAX).
+        del sparse_as_dense
+
+    def gradient(self, loss_fn: Callable, params, *args, **kwargs):
+        return jax.value_and_grad(loss_fn)(params, *args, **kwargs)
+
+
+class DistributedOptimizer:
+    """Optax wrapper with the reference DistributedOptimizer API (:1270).
+
+    ``init``/``update`` pass through to the wrapped optax optimizer; no
+    gradient communication is inserted because none is needed (see module
+    docstring). Keeps a hook point (``postprocess``) mirroring the
+    reference's gradient-postprocess ability.
+    """
+
+    def __init__(self, optimizer,
+                 postprocess: Optional[Callable[[Any], Any]] = None):
+        self._opt = optimizer
+        self._postprocess = postprocess
+
+    def init(self, params):
+        return self._opt.init(params)
+
+    def update(self, grads, opt_state, params=None):
+        if self._postprocess is not None:
+            grads = self._postprocess(grads)
+        return self._opt.update(grads, opt_state, params)
+
+    def apply(self, params, updates):
+        return apply_updates(params, updates)
+
+
+class BroadcastGlobalVariablesCallback:
+    """API-parity shim for the reference Keras callback (:1303).
+
+    Under SPMD the initial weights are already identical (same program, same
+    seed). For multi-process runs restoring from process-local state, call
+    ``on_train_begin(params)`` to broadcast from process 0.
+    """
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, params):
+        if self._done:
+            return params
+        self._done = True
+        return broadcast_variables(params, root_rank=self.root_rank)
+
+
+def apply_updates(params, updates):
+    """params + updates (optax convention: updates already carry the sign)."""
+    return jax.tree.map(lambda p, u: p + u, params, updates)
+
+
+def make_train_step(loss_fn: Callable, optimizer, donate: bool = True,
+                    param_shardings: Any = None):
+    """Build the canonical jitted SPMD train step.
+
+    Args:
+      loss_fn: (params, *batch) -> scalar loss (mean over the global batch —
+        this is what makes replicated-param grads come out averaged, the
+        reference's hvd.allreduce(average) semantics :1260).
+      optimizer: optax optimizer (or DistributedOptimizer).
+      donate: donate params/opt_state buffers (in-place update on TPU).
+      param_shardings: optional full params-tree sharding pytree, pinned on
+        the step's params output (keeps placement stable across steps).
+
+    Returns:
+      step(params, opt_state, *batch) -> (params, opt_state, loss), jitted.
+    """
+    def step(params, opt_state, *batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = apply_updates(params, updates)
+        return params, opt_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    out_shardings = ((param_shardings, None, None)
+                     if param_shardings is not None else None)
+    return jax.jit(step, donate_argnums=donate_argnums,
+                   out_shardings=out_shardings)

@@ -1,0 +1,132 @@
+"""Synthetic model benchmark driver.
+
+Mirror of the reference benchmark driver
+(reference: examples/benchmarks/synthetic_models/main.py): picks one of the
+7 model scales (tiny ... colossal), generates power-law ids, and times the
+jit-compiled hybrid-parallel train step. The step-time numbers are directly
+comparable to BASELINE.md's tables (same table configs, same global batch,
+same optimizer).
+
+  python examples/benchmarks/synthetic_models/main.py --model tiny \
+      --batch_size 65536 --optimizer adagrad
+  python examples/benchmarks/synthetic_models/main.py --model tiny \
+      --force_cpu --devices 8 --batch_size 1024 --steps 8   # smoke
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..", "..")))  # repo root
+
+import argparse
+import statistics
+import time
+from contextlib import nullcontext
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="tiny",
+                   choices=["criteo", "tiny", "small", "medium", "large",
+                            "jumbo", "colossal"])
+    p.add_argument("--batch_size", type=int, default=65536)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--warmup_steps", type=int, default=4)
+    p.add_argument("--optimizer", default="adagrad",
+                   choices=["sgd", "adagrad", "adam"])
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--alpha", type=float, default=1.05,
+                   help="power-law exponent for ids (0 = uniform)")
+    p.add_argument("--num_data_batches", type=int, default=4)
+    p.add_argument("--dist_strategy", default="memory_balanced")
+    p.add_argument("--column_slice_threshold", type=int, default=None)
+    p.add_argument("--dp_input", action="store_true", default=True)
+    p.add_argument("--amp", action="store_true")
+    p.add_argument("--devices", type=int, default=0)
+    p.add_argument("--force_cpu", action="store_true")
+    p.add_argument("--table_scale", type=float, default=1.0,
+                   help="scale vocab sizes down for small-memory smoke runs")
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.force_cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        n = args.devices or 8
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={n}").strip()
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    if args.force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from distributed_embeddings_tpu.models.synthetic import (
+        SYNTHETIC_MODELS, SyntheticModel, InputGenerator)
+    from distributed_embeddings_tpu.parallel.mesh import create_mesh
+    from distributed_embeddings_tpu.training import make_train_step
+
+    cfg = SYNTHETIC_MODELS[args.model]
+    if args.table_scale != 1.0:
+        cfg = cfg._replace(embedding_configs=[
+            c._replace(num_rows=max(4, int(c.num_rows * args.table_scale)))
+            for c in cfg.embedding_configs])
+
+    devices = jax.devices()
+    if args.devices:
+        devices = devices[:args.devices]
+    mesh = create_mesh(devices) if len(devices) > 1 else None
+    print(f"model={cfg.name} devices={len(devices)} "
+          f"batch={args.batch_size} opt={args.optimizer}", flush=True)
+
+    model = SyntheticModel(
+        cfg, mesh=mesh, distributed=True, strategy=args.dist_strategy,
+        column_slice_threshold=args.column_slice_threshold,
+        compute_dtype=jnp.bfloat16 if args.amp else jnp.float32)
+    params = model.init(jax.random.PRNGKey(args.seed))
+
+    opt = {"sgd": optax.sgd, "adagrad": optax.adagrad,
+           "adam": optax.adam}[args.optimizer](args.lr)
+    opt_state = opt.init(params)
+    step_fn = make_train_step(model.loss_fn, opt, donate=False)
+
+    gen = InputGenerator(cfg, args.batch_size, alpha=args.alpha,
+                         num_batches=args.num_data_batches, seed=args.seed)
+
+    ctx = mesh if mesh is not None else nullcontext()
+    with ctx:
+        t0 = time.perf_counter()
+        for i in range(args.warmup_steps):
+            numerical, cats, labels = gen[i % len(gen)]
+            params, opt_state, loss = step_fn(params, opt_state, numerical,
+                                              cats, labels)
+        jax.block_until_ready(loss)
+        print(f"compiled+warm in {time.perf_counter() - t0:.1f}s", flush=True)
+
+        times = []
+        for i in range(args.steps):
+            numerical, cats, labels = gen[i % len(gen)]
+            t0 = time.perf_counter()
+            params, opt_state, loss = step_fn(params, opt_state, numerical,
+                                              cats, labels)
+            jax.block_until_ready(loss)
+            times.append(time.perf_counter() - t0)
+
+    mean_ms = statistics.mean(times) * 1e3
+    p50 = statistics.median(times) * 1e3
+    print(f"step time: mean={mean_ms:.3f} ms  p50={p50:.3f} ms  "
+          f"min={min(times) * 1e3:.3f} ms", flush=True)
+    print(f"throughput: {args.batch_size / statistics.mean(times):,.0f} "
+          f"samples/sec", flush=True)
+
+
+
+if __name__ == "__main__":
+    main()

@@ -362,3 +362,28 @@ def test_mp_call_dispatch():
                  for rank_ids in dist.strategy.input_ids_list]
     outs = dist(params, mp_inputs)
     assert len(outs) == 8 and outs[0].shape == (BATCH, 8)
+
+
+def test_cpu_offload_equivalence():
+    # gpu_embedding_size flags the largest tp tables for offload; they land
+    # in separate buckets and stay numerically exact (reference :449-476)
+    dist, params = check_equivalence(
+        ONE_HOT_8, strategy="memory_balanced", gpu_embedding_size=800)
+    assert any(b.offload for b in dist.plan.tp_buckets)
+    assert any(not b.offload for b in dist.plan.tp_buckets)
+
+
+def test_cpu_offload_bucket_separation():
+    # offloaded tables must never be concat-fused with on-budget tables
+    mesh = make_mesh(8)
+    dist = DistributedEmbedding([Embedding(v, w) for v, w in ONE_HOT_8],
+                                mesh=mesh, strategy="memory_balanced",
+                                gpu_embedding_size=800)
+    assert any(b.offload for b in dist.plan.tp_buckets)
+    assert any(not b.offload for b in dist.plan.tp_buckets)
+
+
+def test_cpu_offload_multihot():
+    specs = [(96, 8, "sum"), (50, 8, "sum"), (100, 8, "mean"), (120, 8, "sum")]
+    check_equivalence(specs, strategy="memory_balanced",
+                      gpu_embedding_size=500)
