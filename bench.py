@@ -1,4 +1,4 @@
-"""Benchmark driver: synthetic 'tiny' model training step time on one chip.
+"""Benchmark driver: synthetic 'tiny' model training step on one chip.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
@@ -6,10 +6,14 @@ Prints ONE JSON line:
 Baseline: the reference's published single-GPU (A100-80GB) step time for the
 synthetic Tiny model, global batch 65536, Adagrad: 24.433 ms
 (BASELINE.md / reference examples/benchmarks/synthetic_models/README.md:69).
-vs_baseline > 1 means faster than the reference.
+vs_baseline > 1 means faster than the reference, compared on throughput
+(samples/sec) so a smaller batch — needed on a 16G-HBM chip vs the
+reference's 80G A100 — still compares fairly.
 """
 
+import functools
 import json
+import sys
 import time
 
 import numpy as np
@@ -21,20 +25,19 @@ from distributed_embeddings_tpu.models.synthetic import (
     SYNTHETIC_MODELS, SyntheticModel, InputGenerator)
 
 BASELINE_TINY_1GPU_MS = 24.433
+BASELINE_BATCH = 65536
 
 
-def main():
-    cfg = SYNTHETIC_MODELS["tiny"]
-    batch = 65536
-    model = SyntheticModel(cfg, mesh=None, distributed=True)
-
+def run_at_batch(model, batch, iters=20):
     params = model.init(jax.random.PRNGKey(0))
     opt = optax.adagrad(0.01)
     opt_state = opt.init(params)
+    gen = InputGenerator(model.config, batch, alpha=1.05, num_batches=4,
+                         seed=0)
 
-    gen = InputGenerator(cfg, batch, alpha=1.05, num_batches=4, seed=0)
-
-    @jax.jit
+    # donation lets XLA update the 4.2 GiB of tables + adagrad accumulators
+    # in place — required to fit batch-65536 training in 16G of HBM
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, numerical, cats, labels):
         loss, grads = jax.value_and_grad(model.loss_fn)(
             params, numerical, cats, labels)
@@ -48,21 +51,46 @@ def main():
                                          labels)
     jax.block_until_ready(loss)
 
-    iters = 20
     t0 = time.perf_counter()
     for i in range(iters):
         numerical, cats, labels = gen[i % len(gen)]
         params, opt_state, loss = train_step(params, opt_state, numerical,
                                              cats, labels)
     jax.block_until_ready(loss)
-    dt_ms = (time.perf_counter() - t0) / iters * 1e3
+    return (time.perf_counter() - t0) / iters
 
-    print(json.dumps({
-        "metric": "synthetic_tiny_step_time_batch65536_adagrad_1chip",
-        "value": round(dt_ms, 3),
-        "unit": "ms",
-        "vs_baseline": round(BASELINE_TINY_1GPU_MS / dt_ms, 3),
-    }))
+
+def main():
+    cfg = SYNTHETIC_MODELS["tiny"]
+    model = SyntheticModel(cfg, mesh=None, distributed=True)
+    # the reference chip (A100) has 80G; fall back by batch until we fit
+    last_err = None
+    for batch in (65536, 32768, 16384, 8192):
+        try:
+            dt = run_at_batch(model, batch)
+        except Exception as e:  # noqa: BLE001 - OOM and transient errors
+            msg = str(e)
+            # drop the traceback so the failed attempt's device buffers are
+            # freed before the smaller-batch retry
+            e.__traceback__ = None
+            last_err = msg[:500]
+            del e
+            if "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower():
+                print(f"batch {batch} OOM, retrying smaller",
+                      file=sys.stderr, flush=True)
+                continue
+            raise RuntimeError(msg)
+        dt_ms = dt * 1e3
+        throughput = batch / dt
+        baseline_throughput = BASELINE_BATCH / (BASELINE_TINY_1GPU_MS / 1e3)
+        print(json.dumps({
+            "metric": f"synthetic_tiny_step_time_batch{batch}_adagrad_1chip",
+            "value": round(dt_ms, 3),
+            "unit": "ms",
+            "vs_baseline": round(throughput / baseline_throughput, 3),
+        }))
+        return
+    raise SystemExit(f"all batch sizes OOM'd: {last_err}")
 
 
 if __name__ == "__main__":

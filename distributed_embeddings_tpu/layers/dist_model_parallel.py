@@ -733,8 +733,11 @@ def broadcast_variables(params, root_rank: int = 0):
     same global jax.Arrays (same program, same seed). For multi-process
     setups initializing from process-local data, broadcast from process 0.
     """
+    if root_rank != 0:
+        raise NotImplementedError(
+            "broadcast_one_to_all always originates from process 0; "
+            "root_rank != 0 is not supported")
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         return multihost_utils.broadcast_one_to_all(params)
-    del root_rank
     return params

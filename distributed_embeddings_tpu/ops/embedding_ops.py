@@ -64,6 +64,15 @@ class SparseIds(NamedTuple):
 IdsLike = Union[jax.Array, RaggedIds, SparseIds]
 
 
+def read_var_no_copy(params: jax.Array) -> jax.Array:
+    """API-parity shim for the reference's ReadVariableNoCopy op
+    (cc/kernels/embedding_lookup_kernels.cc:28-45), which existed to read a
+    TF resource variable without a copy-on-read of the full table. JAX arrays
+    are immutable and jit donation/aliasing provides the no-copy semantics,
+    so this is the identity."""
+    return params
+
+
 def row_to_split(row_ids: jax.Array, nrows: int) -> jax.Array:
     """COO sorted row-indices -> CSR row_splits.
 

@@ -93,6 +93,10 @@ class BroadcastGlobalVariablesCallback:
     """
 
     def __init__(self, root_rank: int = 0):
+        if root_rank != 0:
+            raise NotImplementedError(
+                "broadcast_one_to_all always originates from process 0; "
+                "root_rank != 0 is not supported")
         self.root_rank = root_rank
         self._done = False
 
@@ -104,8 +108,11 @@ class BroadcastGlobalVariablesCallback:
 
 
 def apply_updates(params, updates):
-    """params + updates (optax convention: updates already carry the sign)."""
-    return jax.tree.map(lambda p, u: p + u, params, updates)
+    """params + updates (optax convention). Delegates to optax.apply_updates,
+    which also handles None update leaves (masked optimizers) and casts
+    updates to each param's dtype."""
+    import optax
+    return optax.apply_updates(params, updates)
 
 
 def make_train_step(loss_fn: Callable, optimizer, donate: bool = True,

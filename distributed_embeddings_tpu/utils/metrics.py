@@ -64,7 +64,8 @@ class StreamingAUC:
         fpr = cfp / neg
         tpr = np.concatenate([[0.0], tpr])
         fpr = np.concatenate([[0.0], fpr])
-        return float(np.trapezoid(tpr, fpr))
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2
+        return float(trapezoid(tpr, fpr))
 
 
 def auc_exact(labels: np.ndarray, scores: np.ndarray) -> float:

@@ -1,0 +1,112 @@
+"""Profiling and timing utilities.
+
+The reference has no tracing subsystem — performance work is wall timing in
+example scripts with a device-sync-by-print idiom (reference:
+examples/benchmarks/synthetic_models/main.py:140-158). On TPU, first-class
+tools exist; this module packages the two workflows:
+
+  * ``benchmark(fn, *args)`` — compile-excluded, device-synced step timing
+    (block_until_ready, not print) with mean/p50/min.
+  * ``trace(logdir)`` — context manager around jax.profiler producing an
+    XPlane trace viewable in TensorBoard/Perfetto (op-level HLO timing,
+    HBM traffic, ICI collectives).
+"""
+
+import contextlib
+import statistics
+import time
+from typing import Any, Callable, NamedTuple, Sequence
+
+import jax
+
+__all__ = ["BenchResult", "benchmark", "benchmark_batches", "trace",
+           "annotate"]
+
+
+class BenchResult(NamedTuple):
+    mean_s: float
+    p50_s: float
+    min_s: float
+    iters: int
+    compile_s: float
+
+    @property
+    def mean_ms(self) -> float:
+        return self.mean_s * 1e3
+
+    def __str__(self):
+        return (f"mean={self.mean_s * 1e3:.3f}ms p50={self.p50_s * 1e3:.3f}ms "
+                f"min={self.min_s * 1e3:.3f}ms (compile {self.compile_s:.1f}s, "
+                f"{self.iters} iters)")
+
+
+def benchmark(fn: Callable, *args, iters: int = 20, warmup: int = 2,
+              **kwargs) -> BenchResult:
+    """Time `fn(*args)` with device sync per iteration.
+
+    The first call (compile) is timed separately; `warmup` additional calls
+    run before measurement to settle caches/autotuning.
+    """
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    for _ in range(warmup):
+        out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return BenchResult(mean_s=statistics.mean(times),
+                       p50_s=statistics.median(times),
+                       min_s=min(times), iters=iters, compile_s=compile_s)
+
+
+def benchmark_batches(fn: Callable, batches: Sequence, iters: int = 20,
+                      warmup: int = 2) -> BenchResult:
+    """Like `benchmark` but rotates through pre-built batches (tuples of
+    args) so input-dependent effects (e.g. power-law gather locality) are
+    averaged. fn is called as fn(*batches[i % len(batches)])."""
+    t0 = time.perf_counter()
+    out = fn(*batches[0])
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    for i in range(warmup):
+        out = fn(*batches[i % len(batches)])
+    jax.block_until_ready(out)
+
+    times = []
+    for i in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*batches[i % len(batches)])
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return BenchResult(mean_s=statistics.mean(times),
+                       p50_s=statistics.median(times),
+                       min_s=min(times), iters=iters, compile_s=compile_s)
+
+
+@contextlib.contextmanager
+def trace(logdir: str, host_tracer_level: int = 2):
+    """Capture a jax.profiler trace for everything inside the block:
+
+        with profiling.trace("/tmp/trace"):
+            step(params, batch)
+            jax.block_until_ready(...)
+
+    View with TensorBoard's profile plugin or ui.perfetto.dev.
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up in profiler traces (TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)

@@ -18,7 +18,6 @@ sys.path.insert(0, os.path.abspath(os.path.join(
     os.path.dirname(__file__), "..", "..")))  # repo root
 
 import argparse
-import time
 
 
 def parse_args(argv=None):
@@ -35,17 +34,6 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def bench(fn, args_, steps):
-    import jax
-    out = fn(*args_)                      # compile
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args_)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / steps * 1e3
-
-
 def main(argv=None):
     args = parse_args(argv)
     import numpy as np
@@ -53,6 +41,7 @@ def main(argv=None):
     import jax.numpy as jnp
 
     from distributed_embeddings_tpu.ops import pallas_lookup
+    from distributed_embeddings_tpu.utils import profiling
 
     rng = np.random.RandomState(args.seed)
     table = jnp.asarray(
@@ -94,9 +83,9 @@ def main(argv=None):
     for name, fn in [("fwd fused", fwd_fused), ("fwd xla", fwd_xla),
                      ("fwd+bwd+sgd fused", sgd_fused),
                      ("fwd+bwd+sgd xla", sgd_xla)]:
-        ms = bench(fn, (table,), args.steps)
-        print(f"{name:>20s}: {ms:8.3f} ms "
-              f"({args.batch / ms * 1e3:,.0f} samples/sec)", flush=True)
+        res = profiling.benchmark(fn, table, iters=args.steps, warmup=1)
+        print(f"{name:>20s}: {res.mean_ms:8.3f} ms "
+              f"({args.batch / res.mean_s:,.0f} samples/sec)", flush=True)
 
 
 if __name__ == "__main__":

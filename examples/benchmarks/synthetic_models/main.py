@@ -20,8 +20,6 @@ sys.path.insert(0, os.path.abspath(os.path.join(
     os.path.dirname(__file__), "..", "..", "..")))  # repo root
 
 import argparse
-import statistics
-import time
 from contextlib import nullcontext
 
 
@@ -41,7 +39,10 @@ def parse_args(argv=None):
     p.add_argument("--num_data_batches", type=int, default=4)
     p.add_argument("--dist_strategy", default="memory_balanced")
     p.add_argument("--column_slice_threshold", type=int, default=None)
-    p.add_argument("--dp_input", action="store_true", default=True)
+    p.add_argument("--dp_input", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="--no-dp_input benchmarks the model-parallel input "
+                        "path (feature-sharded data, no id exchange)")
     p.add_argument("--amp", action="store_true")
     p.add_argument("--devices", type=int, default=0)
     p.add_argument("--force_cpu", action="store_true")
@@ -72,6 +73,7 @@ def main(argv=None):
         SYNTHETIC_MODELS, SyntheticModel, InputGenerator)
     from distributed_embeddings_tpu.parallel.mesh import create_mesh
     from distributed_embeddings_tpu.training import make_train_step
+    from distributed_embeddings_tpu.utils import profiling
 
     cfg = SYNTHETIC_MODELS[args.model]
     if args.table_scale != 1.0:
@@ -89,8 +91,18 @@ def main(argv=None):
     model = SyntheticModel(
         cfg, mesh=mesh, distributed=True, strategy=args.dist_strategy,
         column_slice_threshold=args.column_slice_threshold,
+        dp_input=args.dp_input,
         compute_dtype=jnp.bfloat16 if args.amp else jnp.float32)
     params = model.init(jax.random.PRNGKey(args.seed))
+
+    def to_model_inputs(cats):
+        if args.dp_input:
+            return cats
+        # feature-sharded (mp) input: nested per-rank lists in
+        # strategy.input_ids_list order
+        strat = model.embedding.strategy
+        return [[cats[strat.input_groups[1][pos]] for pos in rank_ids]
+                for rank_ids in strat.input_ids_list]
 
     opt = {"sgd": optax.sgd, "adagrad": optax.adagrad,
            "adam": optax.adam}[args.optimizer](args.lr)
@@ -100,31 +112,16 @@ def main(argv=None):
     gen = InputGenerator(cfg, args.batch_size, alpha=args.alpha,
                          num_batches=args.num_data_batches, seed=args.seed)
 
+    batches = [(params, opt_state, gen[i][0], to_model_inputs(gen[i][1]),
+                gen[i][2]) for i in range(len(gen))]
+
     ctx = mesh if mesh is not None else nullcontext()
     with ctx:
-        t0 = time.perf_counter()
-        for i in range(args.warmup_steps):
-            numerical, cats, labels = gen[i % len(gen)]
-            params, opt_state, loss = step_fn(params, opt_state, numerical,
-                                              cats, labels)
-        jax.block_until_ready(loss)
-        print(f"compiled+warm in {time.perf_counter() - t0:.1f}s", flush=True)
-
-        times = []
-        for i in range(args.steps):
-            numerical, cats, labels = gen[i % len(gen)]
-            t0 = time.perf_counter()
-            params, opt_state, loss = step_fn(params, opt_state, numerical,
-                                              cats, labels)
-            jax.block_until_ready(loss)
-            times.append(time.perf_counter() - t0)
-
-    mean_ms = statistics.mean(times) * 1e3
-    p50 = statistics.median(times) * 1e3
-    print(f"step time: mean={mean_ms:.3f} ms  p50={p50:.3f} ms  "
-          f"min={min(times) * 1e3:.3f} ms", flush=True)
-    print(f"throughput: {args.batch_size / statistics.mean(times):,.0f} "
-          f"samples/sec", flush=True)
+        res = profiling.benchmark_batches(step_fn, batches, iters=args.steps,
+                                          warmup=args.warmup_steps)
+    print(f"step time: {res}", flush=True)
+    print(f"throughput: {args.batch_size / res.mean_s:,.0f} samples/sec",
+          flush=True)
 
 
 

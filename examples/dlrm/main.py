@@ -75,6 +75,8 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.synthetic and args.data_path:
+        raise SystemExit("--synthetic and --data_path are mutually exclusive")
     if args.force_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
         n = args.devices or 8

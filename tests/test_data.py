@@ -1,0 +1,92 @@
+"""RawBinaryDataset: split-binary Criteo format, native prefetch path."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.models.data import (
+    DummyDataset, RawBinaryDataset, get_categorical_feature_type)
+
+BATCH = 32
+N_BATCHES = 5
+N_NUM = 4
+TABLE_SIZES = [100, 40000, 7]
+
+
+def write_split_binary(root, n_rows, seed=0):
+    rng = np.random.RandomState(seed)
+    os.makedirs(os.path.join(root, "train"), exist_ok=True)
+    base = os.path.join(root, "train")
+    labels = rng.randint(0, 2, n_rows).astype(np.bool_)
+    labels.tofile(os.path.join(base, "label.bin"))
+    numerical = rng.rand(n_rows, N_NUM).astype(np.float16)
+    numerical.tofile(os.path.join(base, "numerical.bin"))
+    cats = []
+    for i, size in enumerate(TABLE_SIZES):
+        dtype = get_categorical_feature_type(size)
+        c = rng.randint(0, size, n_rows).astype(dtype)
+        c.tofile(os.path.join(base, f"cat_{i}.bin"))
+        cats.append(c)
+    return labels, numerical, cats
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_raw_binary_roundtrip(tmp_path, use_native):
+    n_rows = BATCH * N_BATCHES
+    labels, numerical, cats = write_split_binary(str(tmp_path), n_rows)
+    ds = RawBinaryDataset(
+        str(tmp_path), batch_size=BATCH, numerical_features=N_NUM,
+        categorical_features=list(range(len(TABLE_SIZES))),
+        categorical_feature_sizes=TABLE_SIZES,
+        use_native_prefetch=use_native, prefetch_depth=3)
+    assert len(ds) == N_BATCHES
+    for b in range(N_BATCHES):
+        num_b, cats_b, labels_b = ds[b]
+        sl = slice(b * BATCH, (b + 1) * BATCH)
+        np.testing.assert_allclose(
+            num_b, numerical[sl].astype(np.float32), rtol=1e-3)
+        np.testing.assert_array_equal(
+            labels_b[:, 0], labels[sl].astype(np.float32))
+        for i, c in enumerate(cats_b):
+            np.testing.assert_array_equal(c, cats[i][sl].astype(np.int32))
+
+
+def test_raw_binary_mp_input_reads_own_tables(tmp_path):
+    # model-parallel input: this process loads only its own tables
+    # (reference utils.py:260-266)
+    n_rows = BATCH * N_BATCHES
+    _, _, cats = write_split_binary(str(tmp_path), n_rows)
+    ds = RawBinaryDataset(
+        str(tmp_path), batch_size=BATCH, numerical_features=N_NUM,
+        categorical_features=[2],
+        categorical_feature_sizes=TABLE_SIZES,
+        use_native_prefetch=False)
+    _, cats_b, _ = ds[1]
+    assert len(cats_b) == 1
+    np.testing.assert_array_equal(cats_b[0],
+                                  cats[2][BATCH:2 * BATCH].astype(np.int32))
+
+
+def test_raw_binary_dp_batch_shard(tmp_path):
+    n_rows = BATCH * N_BATCHES
+    _, _, cats = write_split_binary(str(tmp_path), n_rows)
+    ds = RawBinaryDataset(
+        str(tmp_path), batch_size=BATCH, numerical_features=N_NUM,
+        categorical_features=[0], categorical_feature_sizes=TABLE_SIZES,
+        dp_input=True, offset=8, local_batch_size=8,
+        use_native_prefetch=False)
+    _, cats_b, labels_b = ds[0]
+    assert labels_b.shape == (8, 1)
+    np.testing.assert_array_equal(cats_b[0],
+                                  cats[0][8:16].astype(np.int32))
+
+
+def test_dummy_dataset_shapes():
+    ds = DummyDataset(16, N_NUM, TABLE_SIZES, num_batches=2, hotness=[1, 3, 2])
+    numerical, cats, labels = ds[0]
+    assert numerical.shape == (16, N_NUM)
+    assert [c.shape for c in cats] == [(16, 1), (16, 3), (16, 2)]
+    assert labels.shape == (16, 1)
+    with pytest.raises(IndexError):
+        ds[2]

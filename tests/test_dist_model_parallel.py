@@ -387,3 +387,31 @@ def test_cpu_offload_multihot():
     specs = [(96, 8, "sum"), (50, 8, "sum"), (100, 8, "mean"), (120, 8, "sum")]
     check_equivalence(specs, strategy="memory_balanced",
                       gpu_embedding_size=500)
+
+
+class CustomEmbedding:
+    """User-defined layer: anything exposing get_config() with
+    input_dim/output_dim is distributable (reference CustomEmbedding
+    dist_model_parallel_test.py:48-66 — gather semantics, config contract)."""
+
+    def __init__(self, input_dim, output_dim):
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def get_config(self):
+        return {"input_dim": self.input_dim, "output_dim": self.output_dim}
+
+
+def test_custom_embedding_layer():
+    rng = np.random.RandomState(11)
+    specs = [(96, 8), (50, 8), (100, 16), (120, 8)]
+    embeddings = [CustomEmbedding(v, w) for v, w in specs]
+    mesh = make_mesh(8)
+    dist = DistributedEmbedding(embeddings, mesh=mesh, strategy="basic")
+    weights = [rng.randn(v, w).astype(np.float32) for v, w in specs]
+    params = dist.set_weights(weights)
+    inputs = [jnp.asarray(rng.randint(0, v, size=(BATCH,))) for v, w in specs]
+    outs = dist.apply(params, inputs)
+    for w, x, o in zip(weights, inputs, outs):
+        np.testing.assert_allclose(np.asarray(o), w[np.asarray(x)],
+                                   rtol=1e-6)

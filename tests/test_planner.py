@@ -164,3 +164,34 @@ def test_world1_single_rank():
     assert s.strategy == "basic"
     assert len(s.table_ids) == 1
     assert sorted(s.table_ids[0]) == [0, 1]
+
+
+def test_column_slice_merge_no_dup_table_per_rank():
+    # slices of one table landing on the same rank are re-merged, so no rank
+    # holds the same table twice (reference test_column_slice_merge :412-424)
+    embs = tables((1000, 16), (10, 4), (10, 4), (10, 4))
+    s = DistEmbeddingStrategy(embs, 2, column_slice_threshold=1000)
+    for rank_ids in s.table_ids:
+        assert len(rank_ids) == len(set(rank_ids))
+
+
+def test_auto_concat_fuses_same_width_tables():
+    # 8 same-width tables over 2 ranks -> exactly 1 fused table per rank
+    # (reference test_8table_width2_auto_concat :449-459)
+    embs = tables(*[(100 + i, 2) for i in range(8)])
+    s = DistEmbeddingStrategy(embs, 2, strategy="basic")
+    for rank_configs in s.local_configs:
+        assert len(rank_configs) == 1
+    plan = lower_strategy(s)
+    assert len(plan.tp_buckets) == 1
+    assert plan.tp_buckets[0].rows == [
+        sum(100 + i for i in range(0, 8, 2)),
+        sum(100 + i for i in range(1, 8, 2))]
+
+
+def test_offload_tables_not_fused_with_resident():
+    embs = tables((1000, 8), (900, 8), (10, 8), (20, 8))
+    s = DistEmbeddingStrategy(embs, 2, gpu_embedding_size=500)
+    plan = lower_strategy(s)
+    offloads = {b.offload for b in plan.tp_buckets}
+    assert offloads == {True, False}

@@ -123,3 +123,19 @@ def test_streaming_auc_matches_exact():
     want = auc_exact(labels, 1 / (1 + np.exp(-logits)))
     assert abs(got - want) < 5e-3, (got, want)
     assert got > 0.7
+
+
+def test_profiling_benchmark_harness():
+    from distributed_embeddings_tpu.utils import profiling
+
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    x = jnp.ones((64, 64))
+    res = profiling.benchmark(f, x, iters=5, warmup=1)
+    assert res.iters == 5
+    assert res.mean_s > 0 and res.min_s <= res.mean_s
+    with profiling.annotate("region"):
+        jax.block_until_ready(f(x))
+    assert "mean=" in str(res)
