@@ -9,6 +9,12 @@ synthetic Tiny model, global batch 65536, Adagrad: 24.433 ms
 vs_baseline > 1 means faster than the reference, compared on throughput
 (samples/sec) so a smaller batch — needed on a 16G-HBM chip vs the
 reference's 80G A100 — still compares fairly.
+
+Robustness: TPU backend init over the tunnel can fail transiently
+(round-1 postmortem: a single UNAVAILABLE at init aborted the whole bench).
+`_init_backend_with_retry` retries jax.devices() with backoff before giving
+up, and OOM is detected by XlaRuntimeError/RESOURCE_EXHAUSTED status rather
+than substring-matching arbitrary exception text.
 """
 
 import functools
@@ -26,6 +32,37 @@ from distributed_embeddings_tpu.models.synthetic import (
 
 BASELINE_TINY_1GPU_MS = 24.433
 BASELINE_BATCH = 65536
+
+
+def _init_backend_with_retry(attempts: int = 4, backoff_s: float = 20.0):
+    """jax.devices() with retry: TPU plugin init over the tunnel can throw a
+    transient UNAVAILABLE (seen in BENCH_r01). Returns the device list."""
+    last = None
+    for i in range(attempts):
+        try:
+            return jax.devices()
+        except RuntimeError as e:  # jax re-raises init failures as RuntimeError
+            last = e
+            print(f"backend init attempt {i + 1}/{attempts} failed: "
+                  f"{str(e)[:200]}", file=sys.stderr, flush=True)
+            try:
+                jax.extend.backend.clear_backends()
+            except Exception:  # noqa: BLE001 - best-effort cache clear
+                pass
+            if i + 1 < attempts:
+                time.sleep(backoff_s * (i + 1))
+    raise last
+
+
+def _is_oom(e: Exception) -> bool:
+    """True only for genuine device OOM: an XLA runtime error whose status is
+    RESOURCE_EXHAUSTED — not any exception that merely quotes the string."""
+    is_xla_err = type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError")
+    try:
+        is_xla_err = is_xla_err or isinstance(e, jax.errors.JaxRuntimeError)
+    except AttributeError:
+        pass
+    return is_xla_err and "RESOURCE_EXHAUSTED" in str(e)
 
 
 def run_at_batch(model, batch, iters=20):
@@ -61,6 +98,10 @@ def run_at_batch(model, batch, iters=20):
 
 
 def main():
+    devices = _init_backend_with_retry()
+    print(f"backend: {devices[0].platform} x{len(devices)} "
+          f"({devices[0].device_kind})", file=sys.stderr, flush=True)
+
     cfg = SYNTHETIC_MODELS["tiny"]
     model = SyntheticModel(cfg, mesh=None, distributed=True)
     # the reference chip (A100) has 80G; fall back by batch until we fit
@@ -68,18 +109,17 @@ def main():
     for batch in (65536, 32768, 16384, 8192):
         try:
             dt = run_at_batch(model, batch)
-        except Exception as e:  # noqa: BLE001 - OOM and transient errors
-            msg = str(e)
+        except Exception as e:  # noqa: BLE001
+            if not _is_oom(e):
+                raise
             # drop the traceback so the failed attempt's device buffers are
             # freed before the smaller-batch retry
+            last_err = str(e)[:500]
             e.__traceback__ = None
-            last_err = msg[:500]
             del e
-            if "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower():
-                print(f"batch {batch} OOM, retrying smaller",
-                      file=sys.stderr, flush=True)
-                continue
-            raise RuntimeError(msg)
+            print(f"batch {batch} OOM, retrying smaller",
+                  file=sys.stderr, flush=True)
+            continue
         dt_ms = dt * 1e3
         throughput = batch / dt
         baseline_throughput = BASELINE_BATCH / (BASELINE_TINY_1GPU_MS / 1e3)

@@ -6,10 +6,13 @@ re-designed SPMD-first:
 
   * One 1-D `jax.sharding.Mesh` axis plays both the dp and mp role (the
     reference likewise requires dp ranks == mp ranks, :757).
-  * The forward is a single `shard_map` region: ids move dp->mp via
-    `lax.all_gather` (each device then selects the features it owns),
-    embedding outputs move mp->dp via `lax.all_to_all` — the XLA-collective
-    equivalent of the reference's hvd.alltoall choreography (:842-887).
+  * The forward is a single `shard_map` region: ids move dp->mp via a true
+    `lax.all_to_all` — each device sends every destination only the ids of
+    the features that destination owns, packed per (bucket, hotness)
+    "exchange group" so per-device id traffic is
+    O(owned features x true hotness), matching the reference's
+    hvd.alltoall-with-splits (:169-288, :211) rather than replicating all
+    ids everywhere. Embedding outputs move mp->dp the same way (:870-872).
   * Row-sliced tables: all_gather ids -> masked local lookup -> psum_scatter,
     the equivalent of hvd.grouped_allgather + grouped_reducescatter (:889-904).
     XLA gather clamps out-of-bounds instead of zero-filling like TF, so
@@ -19,6 +22,15 @@ re-designed SPMD-first:
     replicated (dp) params are psummed by the shard_map transpose — the
     behavioral contract of the reference's patched tape (:1242-1267) falls out
     for free.
+
+Exchange-group design (the TPU answer to Horovod's variable `splits`):
+XLA collectives need static shapes, so the variable per-destination split
+sizes of hvd.alltoall are re-expressed as a *set* of fixed-shape all_to_alls.
+Slots of one fused bucket are grouped by their input's hotness k; each group
+exchanges a dense [world, B_local, f_max_g, k] block. Within a group there is
+no hotness padding at all (every member has exactly k ids), and f_max_g
+padding is bounded by per-destination feature-count imbalance, which the
+planner's placement strategies already minimize.
 """
 
 import math
@@ -30,7 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_embeddings_tpu.ops import embedding_ops
+from distributed_embeddings_tpu.ops import embedding_ops, pallas_lookup
 from distributed_embeddings_tpu.ops.embedding_ops import RaggedIds, SparseIds
 from distributed_embeddings_tpu.parallel.mesh import DEFAULT_AXIS, create_mesh
 from distributed_embeddings_tpu.parallel.planner import DistEmbeddingStrategy
@@ -77,6 +89,26 @@ class _PreparedInput:
         self.k = k
 
 
+class _ExchangeGroup:
+    """The slots of one tp bucket whose inputs share hotness k — one
+    fixed-shape all_to_all unit (see module docstring). Static planning data
+    computed at trace time from the plan + each input's (static) hotness."""
+
+    __slots__ = ("bucket", "k", "class_inputs", "sel", "offs", "f_max",
+                 "need_w", "rank_slots")
+
+    def __init__(self, bucket, k, class_inputs, sel, offs, f_max, need_w,
+                 rank_slots):
+        self.bucket = bucket            # index into plan.tp_buckets
+        self.k = k                      # hotness shared by all member inputs
+        self.class_inputs = class_inputs  # tp-input indices, stack order
+        self.sel = sel                  # [world, f_max] -> class input pos
+        self.offs = offs                # [world, f_max] fused-table row offsets
+        self.f_max = f_max
+        self.need_w = need_w
+        self.rank_slots = rank_slots    # per rank: ordered member TPSlots
+
+
 class DistributedEmbedding:
     """Distributed embedding wrapper: plans placement for a list of embedding
     tables and runs the hybrid-parallel lookup over a device mesh.
@@ -114,7 +146,8 @@ class DistributedEmbedding:
                  gpu_embedding_size: Optional[int] = None,
                  mesh: Optional[Mesh] = None,
                  world_size: Optional[int] = None,
-                 input_max_hotness: Optional[Sequence[Optional[int]]] = None):
+                 input_max_hotness: Optional[Sequence[Optional[int]]] = None,
+                 use_custom_kernel: bool = True):
         if mesh is None and world_size is not None and world_size > 1:
             mesh = create_mesh(jax.devices()[:world_size])
         self.mesh = mesh
@@ -153,6 +186,11 @@ class DistributedEmbedding:
         self.input_max_hotness = (list(input_max_hotness)
                                   if input_max_hotness is not None else None)
         self._n_inputs = len(self.strategy.input_table_map)
+        # like the reference Embedding's use_custom_kernel (embedding.py:72):
+        # route multi-hot fused-bucket lookups through the Pallas kernels when
+        # on a TPU backend; plain XLA gather+reduce otherwise.
+        self.use_custom_kernel = use_custom_kernel
+        self._groups_cache: dict = {}
 
     # ------------------------------------------------------------------ init
     def _init_tp_bucket(self, key, b: int) -> jax.Array:
@@ -264,33 +302,84 @@ class DistributedEmbedding:
             prepped.append(self._prepare_one(x, mh))
         return prepped
 
-    def _bucket_gather(self, table: jax.Array, ids_l: jax.Array,
-                       offload: bool) -> jax.Array:
-        """Local fused-table gather. `offload` marks buckets past the
-        gpu_embedding_size budget; a true host-side gather (only looked-up
-        rows crossing PCIe, the reference's /CPU:0 lookup :829-831) needs
-        jax.experimental.compute_on('device_host'), whose memory-space
-        propagation does not reach through shard_map as of jax 0.9 — so the
-        gather stays device-side for now."""
-        del offload
-        return jnp.take(table, ids_l, axis=0)
+    def _exchange_groups(self, tp_prep: Sequence[_PreparedInput]):
+        """Compute the (bucket, hotness) exchange groups and the per-input
+        assembly map for a given set of prepared inputs.
 
-    @staticmethod
-    def _pad_cols(p: _PreparedInput, k_target: int, need_w: bool, batch: int):
-        """Pad one prepared input's ids (and weights) to k_target columns;
-        synthesizes all-ones weights when needed. Shared by the dp-input and
-        mp-input stacking paths."""
-        ids = p.ids.astype(jnp.int32)
-        pad = k_target - p.k
-        if pad:
-            ids = jnp.pad(ids, ((0, 0), (0, pad)))
-        w = None
-        if need_w:
-            w = (p.weights if p.weights is not None
-                 else jnp.ones((batch, p.k), jnp.float32))
-            if pad:
-                w = jnp.pad(w, ((0, 0), (0, pad)))
-        return ids, w
+        Returns (groups, assembly) where assembly[i] is the ordered list of
+        (rank, group_idx, slot_in_group) triples for tp input i — the same
+        rank-major slot order the plan's weight layout uses (col_cursor order,
+        reference :921-936), so column-slice re-concat stays correct.
+        Cached per hotness/weights signature (one entry per jit trace shape).
+        """
+        key = tuple((p.k, p.weights is not None) for p in tp_prep)
+        hit = self._groups_cache.get(key)
+        if hit is not None:
+            return hit
+        world = self.world_size
+        per_bk: dict = {}   # (bucket, k) -> per-rank [(slot_idx, TPSlot)...]
+        order: List[Tuple[int, int]] = []
+        for b, bucket in enumerate(self.plan.tp_buckets):
+            for r, slots in enumerate(bucket.slots):
+                for j, s in enumerate(slots):
+                    k = tp_prep[s.tp_input].k
+                    if (b, k) not in per_bk:
+                        per_bk[(b, k)] = [[] for _ in range(world)]
+                        order.append((b, k))
+                    per_bk[(b, k)][r].append((j, s))
+        groups: List[_ExchangeGroup] = []
+        slot_map: dict = {}  # (bucket, rank, slot_idx_in_bucket) -> (g, j_g)
+        for g, (b, k) in enumerate(order):
+            ranks = per_bk[(b, k)]
+            class_inputs = sorted({s.tp_input for lst in ranks
+                                   for (_, s) in lst})
+            pos = {i: c for c, i in enumerate(class_inputs)}
+            f_max = max(len(lst) for lst in ranks)
+            sel = np.zeros((world, f_max), np.int32)
+            offs = np.zeros((world, f_max), np.int32)
+            rank_slots = []
+            for r, lst in enumerate(ranks):
+                for j_g, (j, s) in enumerate(lst):
+                    sel[r, j_g] = pos[s.tp_input]
+                    offs[r, j_g] = s.row_offset
+                    slot_map[(b, r, j)] = (g, j_g)
+                rank_slots.append([s for (_, s) in lst])
+            need_w = any(tp_prep[i].weights is not None for i in class_inputs)
+            groups.append(_ExchangeGroup(b, k, class_inputs, sel, offs,
+                                         f_max, need_w, rank_slots))
+        assembly = [
+            [(rank, *slot_map[(bb, rank, jj)]) for (rank, bb, jj) in slots]
+            for slots in self.plan.tp_input_slots
+        ]
+        self._groups_cache[key] = res = (groups, assembly)
+        return res
+
+    def _group_lookup(self, table: jax.Array, ids: jax.Array,
+                      weights: Optional[jax.Array], combiner: Optional[str],
+                      offload: bool) -> jax.Array:
+        """Local fused-bucket lookup + combine: ids [B, f, k] -> [B, f, wf].
+
+        Multi-hot sum/mean groups route through the Pallas fused kernel on
+        TPU (the hot-loop equivalent of the reference's CUDA combiner,
+        cu:175-336); everything else is XLA gather + reduce, which XLA fuses.
+
+        `offload` marks buckets past the gpu_embedding_size budget; a true
+        host-side gather (only looked-up rows crossing the host link, the
+        reference's /CPU:0 lookup :829-831) needs memory-space propagation
+        through shard_map, not available as of jax 0.9 — device-side for now.
+        """
+        del offload
+        b_sz, f, k = ids.shape
+        if (combiner in ("sum", "mean") and k > 1 and self.use_custom_kernel
+                and pallas_lookup.is_tpu_backend()):
+            w = (weights if weights is not None
+                 else jnp.ones((b_sz, f, k), jnp.float32))
+            out = pallas_lookup.fused_embedding_lookup(
+                table, ids.reshape(b_sz * f, k), w.reshape(b_sz * f, k),
+                combiner)
+            return out.reshape(b_sz, f, out.shape[-1])
+        emb = jnp.take(table, ids, axis=0)          # [B, f, k, w]
+        return _combine(emb, weights, combiner)
 
     # -------------------------------------------------------------- forward
     def _my_index(self):
@@ -303,18 +392,19 @@ class DistributedEmbedding:
         return jnp.take(jnp.asarray(const), self._my_index(), axis=0)
 
     def _forward_local(self, dp_params, tp_params, row_params,
-                       dp_in, tp_ids, tp_w, row_in):
+                       dp_in, group_ids, group_w, row_in, groups):
         """The per-device forward (shard_map body when world > 1).
 
         Args:
           dp_in / row_in: lists of (ids [B_l, k], weights or None) per input.
-          tp_ids: stacked tp ids [B_l, n_tp_inputs, K_max] (or None).
-          tp_w: matching weights [B_l, n_tp, K_max] or None.
+          group_ids: per exchange group, stacked ids [B_l, n_g, k_g].
+          group_w: matching weights [B_l, n_g, k_g] or None per group.
+          groups: the static _ExchangeGroup records.
 
         Returns (dp_outs, ex_list, row_outs):
-          dp_outs: [B_l, K, w] per dp input (hotness axis kept; combined later)
-          ex_list: per bucket [world_src, B_l, f_max, wf]
-          row_outs: [B_l, K, w] partial sums scattered over batch.
+          dp_outs: [B_l, w] (or [B_l, K, w]) per dp input
+          ex_list: per group [world_src, B_l, f_max_g, wf]
+          row_outs: [B_l, ...] partial sums scattered over batch.
         """
         world = self.world_size
         strat = self.strategy
@@ -327,25 +417,41 @@ class DistributedEmbedding:
             emb = jnp.take(table, ids, axis=0)           # [B_l, k, w]
             dp_outs.append(_combine(emb, weights, cfg.get("combiner")))
 
-        # ---- table-parallel: all_gather ids, local fused lookup, all_to_all
+        # ---- table-parallel: per-group all_to_all id exchange (dp->mp),
+        # local fused lookup, all_to_all back (mp->dp). Each destination
+        # receives only ids for features it owns (reference hvd.alltoall
+        # with splits, :211) — not an all_gather of everything.
         ex_list = []
-        if tp_ids is not None:
+        for g, grp in enumerate(groups):
+            ids = group_ids[g]                               # [B_l, n_g, k]
+            blocal = ids.shape[0]
+            sel = jnp.asarray(grp.sel.reshape(-1))           # [world*f_max]
+            send = jnp.take(ids, sel, axis=1).reshape(
+                blocal, world, grp.f_max, grp.k)
+            send = jnp.moveaxis(send, 1, 0)                  # [world, B_l, f, k]
+            w_x = None
+            if group_w[g] is not None:
+                w_send = jnp.take(group_w[g], sel, axis=1).reshape(
+                    blocal, world, grp.f_max, grp.k)
+                w_send = jnp.moveaxis(w_send, 1, 0)
             if world > 1:
-                g_ids = lax.all_gather(tp_ids, self.axis, axis=0, tiled=True)
-                g_w = (lax.all_gather(tp_w, self.axis, axis=0, tiled=True)
-                       if tp_w is not None else None)
+                recv = lax.all_to_all(send, self.axis, split_axis=0,
+                                      concat_axis=0)
+                if group_w[g] is not None:
+                    w_recv = lax.all_to_all(w_send, self.axis, split_axis=0,
+                                            concat_axis=0)
+                    w_x = w_recv.reshape(-1, grp.f_max, grp.k)
             else:
-                g_ids, g_w = tp_ids, tp_w
-            for b, bucket in enumerate(self.plan.tp_buckets):
-                sel = self._device_const(bucket.feature_sel)       # [f_max]
-                offs = self._device_const(bucket.feature_offsets)  # [f_max]
-                ids_l = jnp.take(g_ids, sel, axis=1)               # [B, f_max, K]
-                ids_l = ids_l + offs[None, :, None].astype(ids_l.dtype)
-                table = tp_params[b][0]                            # [rows_max, w]
-                emb = self._bucket_gather(table, ids_l, bucket.offload)
-                w_l = jnp.take(g_w, sel, axis=1) if g_w is not None else None
-                out = _combine(emb, w_l, bucket.combiner)          # [B, f, wf]
-                ex_list.append(self._tp_bucket_exchange(out))
+                recv = send
+                if group_w[g] is not None:
+                    w_x = w_send.reshape(-1, grp.f_max, grp.k)
+            ids_x = recv.reshape(-1, grp.f_max, grp.k)       # [B, f, k]
+            offs = self._device_const(grp.offs)              # [f_max]
+            ids_x = ids_x + offs[None, :, None].astype(ids_x.dtype)
+            bucket = self.plan.tp_buckets[grp.bucket]
+            out = self._group_lookup(tp_params[grp.bucket][0], ids_x, w_x,
+                                     bucket.combiner, bucket.offload)
+            ex_list.append(self._tp_bucket_exchange(out))
 
         # ---- row-sliced tables: all_gather ids, masked lookup, psum_scatter
         row_outs = self._row_slice_local(row_params, row_in)
@@ -424,20 +530,24 @@ class DistributedEmbedding:
         tp_prep = [prepped[i] for i in strat.input_groups[1]]
         row_prep = [prepped[i] for i in strat.input_groups[2]]
 
-        # stack tp inputs into [B, n_tp, K_max] (+ weights if any needed)
-        tp_ids, tp_w = None, None
+        # stack tp inputs per exchange group: [B, n_g, k_g] (+ weights where
+        # any member input carries them — same-k members need no pad weights)
+        groups, assembly = ([], [])
+        group_ids: List[jax.Array] = []
+        group_w: List[Optional[jax.Array]] = []
         if tp_prep:
-            k_max = max(p.k for p in tp_prep)
-            need_w = (any(p.weights is not None for p in tp_prep)
-                      or any(p.k != k_max for p in tp_prep))
-            id_cols, w_cols = [], []
-            for p in tp_prep:
-                ids, w = self._pad_cols(p, k_max, need_w, batch)
-                id_cols.append(ids)
-                if need_w:
-                    w_cols.append(w)
-            tp_ids = jnp.stack(id_cols, axis=1)
-            tp_w = jnp.stack(w_cols, axis=1) if need_w else None
+            groups, assembly = self._exchange_groups(tp_prep)
+            for grp in groups:
+                members = [tp_prep[i] for i in grp.class_inputs]
+                group_ids.append(jnp.stack(
+                    [p.ids.astype(jnp.int32) for p in members], axis=1))
+                if grp.need_w:
+                    group_w.append(jnp.stack(
+                        [(p.weights if p.weights is not None
+                          else jnp.ones((batch, p.k), jnp.float32))
+                         for p in members], axis=1))
+                else:
+                    group_w.append(None)
 
         dp_in = [(p.ids, p.weights) for p in dp_prep]
         row_in = [(p.ids, p.weights) for p in row_prep]
@@ -445,30 +555,29 @@ class DistributedEmbedding:
         if world > 1:
             specs = lambda tree, spec: jax.tree.map(lambda _: spec, tree)
             args = (params["dp"], params["tp"], params["row"],
-                    dp_in, tp_ids, tp_w, row_in)
+                    dp_in, group_ids, group_w, row_in)
             in_specs = (specs(params["dp"], P()),
                         specs(params["tp"], P(self.axis)),
                         specs(params["row"], P(self.axis)),
                         specs(dp_in, P(self.axis)),
-                        specs(tp_ids, P(self.axis)),
-                        specs(tp_w, P(self.axis)),
+                        specs(group_ids, P(self.axis)),
+                        specs(group_w, P(self.axis)),
                         specs(row_in, P(self.axis)))
             out_specs = (
                 [P(self.axis)] * len(dp_in),
-                [P(None, self.axis)] * len(self.plan.tp_buckets
-                                           if tp_ids is not None else []),
+                [P(None, self.axis)] * len(groups),
                 [P(self.axis)] * len(row_in),
             )
             dp_outs, ex_list, row_outs = jax.shard_map(
-                lambda d, t, r, di, ti, tw, ri: self._forward_local(
-                    d, t, r, di, ti, tw, ri),
+                lambda d, t, r, di, gi, gw, ri: self._forward_local(
+                    d, t, r, di, gi, gw, ri, groups),
                 mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False,
             )(*args)
         else:
             dp_outs, ex_list, row_outs = self._forward_local(
                 params["dp"], params["tp"], params["row"],
-                dp_in, tp_ids, tp_w, row_in)
+                dp_in, group_ids, group_w, row_in, groups)
 
         # ---- assemble per-input outputs ------------------------------------
         dp_final = []
@@ -478,7 +587,8 @@ class DistributedEmbedding:
             dp_final.append(self._restore_shape(out, p, cfg.get("combiner"),
                                                 cfg["output_dim"]))
 
-        tp_final = self._assemble_tp_outputs(ex_list, tp_prep, batch)
+        tp_final = self._assemble_tp_outputs(ex_list, tp_prep, batch,
+                                             groups, assembly)
 
         row_final = []
         for j, out in enumerate(row_outs):
@@ -489,24 +599,26 @@ class DistributedEmbedding:
         outputs = dp_final + tp_final + row_final
         return [outputs[idx] for idx in strat.rev_group_ids]
 
-    def _assemble_tp_outputs(self, ex_list, tp_preps, batch) -> List[jax.Array]:
-        """Slice the exchanged bucket outputs back into per-input arrays:
+    def _assemble_tp_outputs(self, ex_list, tp_preps, batch, groups,
+                             assembly) -> List[jax.Array]:
+        """Slice the exchanged group outputs back into per-input arrays:
         reorder by slot, re-concat column slices (reference :876-886).
 
         Args:
-          ex_list: per bucket [world_src, B, f_max, wf] global arrays.
+          ex_list: per exchange group [world_src, B, f_max_g, wf] globals.
           tp_preps: _PreparedInput per tp-group input position.
+          groups / assembly: from _exchange_groups (rank-major slot order).
         """
         strat = self.strategy
         tp_final = []
         for i, p in enumerate(tp_preps):
             parts = []
-            for (rank, b, f) in self.plan.tp_input_slots[i]:
-                bucket = self.plan.tp_buckets[b]
-                part = ex_list[b][rank, :, f, :]            # [B, wf]
+            for (rank, g, j_g) in assembly[i]:
+                grp = groups[g]
+                bucket = self.plan.tp_buckets[grp.bucket]
+                part = ex_list[g][rank, :, j_g, :]          # [B, wf]
                 if bucket.combiner is None:
-                    k_all = part.shape[-1] // bucket.width
-                    part = part.reshape(batch, k_all, bucket.width)[:, :p.k, :]
+                    part = part.reshape(batch, grp.k, bucket.width)
                 parts.append(part)
             out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=-1)
             cfg = strat.global_configs[
@@ -572,44 +684,42 @@ class DistributedEmbedding:
             raise ValueError(
                 f"Global batch {batch} not divisible by device count {world}")
 
-        # stack per-bucket mp inputs: ids [world, B, f_max, k_b] (+ weights)
-        bucket_ids, bucket_w = [], []
-        for b, bucket in enumerate(self.plan.tp_buckets):
-            slot_preps = [input_prep[s.tp_input]
-                          for slots in bucket.slots for s in slots]
-            k_b = max((p.k for p in slot_preps), default=1)
-            need_w = any(p.weights is not None or p.k != k_b
-                         for p in slot_preps)
-            f_max = max(bucket.f_max, 1)
+        # mp input skips the dp->mp exchange entirely (the loader already
+        # read feature-sharded data) — stack each rank's local features per
+        # exchange group: ids [world, B, f_max_g, k_g] (+ weights).
+        tp_preps = [input_prep[i] for i in range(len(strat.input_groups[1]))]
+        groups, assembly = self._exchange_groups(tp_preps)
+        group_ids, group_w = [], []
+        for grp in groups:
             per_rank_ids, per_rank_w = [], []
             for r in range(world):
                 cols_i, cols_w = [], []
-                for s in bucket.slots[r]:
+                for s in grp.rank_slots[r]:
                     p = prepped[r][rank_pos[r][s.tp_input]]
-                    ids, w = self._pad_cols(p, k_b, need_w, batch)
-                    cols_i.append(ids)
-                    if need_w:
-                        cols_w.append(w)
-                while len(cols_i) < f_max:
-                    cols_i.append(jnp.zeros((batch, k_b), jnp.int32))
-                    if need_w:
-                        cols_w.append(jnp.zeros((batch, k_b), jnp.float32))
+                    cols_i.append(p.ids.astype(jnp.int32))
+                    if grp.need_w:
+                        cols_w.append(p.weights if p.weights is not None
+                                      else jnp.ones((batch, p.k), jnp.float32))
+                while len(cols_i) < grp.f_max:
+                    cols_i.append(jnp.zeros((batch, grp.k), jnp.int32))
+                    if grp.need_w:
+                        cols_w.append(jnp.zeros((batch, grp.k), jnp.float32))
                 per_rank_ids.append(jnp.stack(cols_i, axis=1))  # [B, f, k]
-                if need_w:
+                if grp.need_w:
                     per_rank_w.append(jnp.stack(cols_w, axis=1))
-            bucket_ids.append(jnp.stack(per_rank_ids))          # [world, B, f, k]
-            bucket_w.append(jnp.stack(per_rank_w) if need_w else None)
+            group_ids.append(jnp.stack(per_rank_ids))       # [world, B, f, k]
+            group_w.append(jnp.stack(per_rank_w) if grp.need_w else None)
 
-        def body(tp_params, bucket_ids, bucket_w):
+        def body(tp_params, group_ids, group_w):
             ex_list = []
-            for b, bucket in enumerate(self.plan.tp_buckets):
-                ids_l = bucket_ids[b][0]                        # [B, f, k]
-                offs = self._device_const(bucket.feature_offsets)
+            for g, grp in enumerate(groups):
+                ids_l = group_ids[g][0]                         # [B, f, k]
+                offs = self._device_const(grp.offs)
                 ids_l = ids_l + offs[None, :, None].astype(ids_l.dtype)
-                emb = self._bucket_gather(tp_params[b][0], ids_l,
-                                          bucket.offload)      # [B, f, k, w]
-                w_l = bucket_w[b][0] if bucket_w[b] is not None else None
-                out = _combine(emb, w_l, bucket.combiner)       # [B, f, wf]
+                w_l = group_w[g][0] if group_w[g] is not None else None
+                bucket = self.plan.tp_buckets[grp.bucket]
+                out = self._group_lookup(tp_params[grp.bucket][0], ids_l,
+                                         w_l, bucket.combiner, bucket.offload)
                 ex_list.append(self._tp_bucket_exchange(out))
             return ex_list
 
@@ -618,16 +728,16 @@ class DistributedEmbedding:
             ex_list = jax.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(specs(params["tp"], P(self.axis)),
-                          specs(bucket_ids, P(self.axis)),
-                          specs(bucket_w, P(self.axis))),
-                out_specs=[P(None, self.axis)] * len(self.plan.tp_buckets),
+                          specs(group_ids, P(self.axis)),
+                          specs(group_w, P(self.axis))),
+                out_specs=[P(None, self.axis)] * len(groups),
                 check_vma=False,
-            )(params["tp"], bucket_ids, bucket_w)
+            )(params["tp"], group_ids, group_w)
         else:
-            ex_list = body(params["tp"], bucket_ids, bucket_w)
+            ex_list = body(params["tp"], group_ids, group_w)
 
-        tp_preps = [input_prep[i] for i in range(len(strat.input_groups[1]))]
-        outputs = self._assemble_tp_outputs(ex_list, tp_preps, batch)
+        outputs = self._assemble_tp_outputs(ex_list, tp_preps, batch,
+                                            groups, assembly)
         return [outputs[idx] for idx in strat.rev_group_ids]
 
     @staticmethod

@@ -63,11 +63,11 @@ class TPBucket:
     rows_max: int
     slots: List[List[TPSlot]]       # per rank, in exchange slot order
     f_max: int
-    # [world, f_max] int32 constants (pad slots -> feature 0 / offset 0)
-    feature_sel: np.ndarray
-    feature_offsets: np.ndarray
     # per-rank list of (table_id, row_offset, rows, initializer, dtype)
     init_segments: List[List[Tuple[int, int, int, Any, Any]]]
+    # NOTE: runtime [world, f_max] sel/offset constants live on
+    # _ExchangeGroup (dist_model_parallel._exchange_groups), grouped by
+    # hotness — the bucket itself carries only placement structure.
 
 
 @dataclasses.dataclass
@@ -129,7 +129,6 @@ def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
                     offload=bool(cfg.get("cpu_offload", False)),
                     rows=[0] * world, rows_max=0,
                     slots=[[] for _ in range(world)], f_max=0,
-                    feature_sel=None, feature_offsets=None,
                     init_segments=[[] for _ in range(world)]))
             b = bucket_index[key]
             bucket = buckets[b]
@@ -172,14 +171,6 @@ def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
 
     for bucket in buckets:
         bucket.f_max = max((len(s) for s in bucket.slots), default=0)
-        sel = np.zeros((world, max(bucket.f_max, 1)), dtype=np.int32)
-        offs = np.zeros((world, max(bucket.f_max, 1)), dtype=np.int32)
-        for rank, slots in enumerate(bucket.slots):
-            for j, slot in enumerate(slots):
-                sel[rank, j] = slot.tp_input
-                offs[rank, j] = slot.row_offset
-        bucket.feature_sel = sel
-        bucket.feature_offsets = offs
 
     # ---------------- row-sliced tables -------------------------------------
     row_tables: List[RowTablePlan] = []
