@@ -119,7 +119,10 @@ class DLRM:
             row_slice_threshold=row_slice_threshold,
             data_parallel_threshold=data_parallel_threshold,
             dp_input=dp_input,
-            mesh=mesh)
+            mesh=mesh,
+            # bf16 inside the embedding halves the mp->dp all_to_all bytes
+            compute_dtype=(compute_dtype
+                           if compute_dtype != jnp.float32 else None))
         self.mesh = mesh
 
     def init(self, key) -> dict:

@@ -231,7 +231,10 @@ class SyntheticModel:
                 self.embedding_layers, strategy=strategy,
                 input_table_map=table_map,
                 column_slice_threshold=column_slice_threshold,
-                dp_input=dp_input, mesh=mesh, **dist_kwargs)
+                dp_input=dp_input, mesh=mesh,
+                compute_dtype=(compute_dtype
+                               if compute_dtype != jnp.float32 else None),
+                **dist_kwargs)
         self.mesh = mesh
         self.interact_stride = model_config.interact_stride
 

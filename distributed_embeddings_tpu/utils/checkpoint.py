@@ -42,13 +42,15 @@ def _step_dir(path: str, step: Optional[int]) -> str:
 
 
 def save_checkpoint(path: str, state: Any, step: Optional[int] = None,
-                    force: bool = True) -> str:
+                    force: bool = False) -> str:
     """Save a (possibly sharded) pytree checkpoint with Orbax.
 
     Args:
       path: checkpoint root directory.
       state: pytree of jax.Arrays (params / {'params':..., 'opt_state':...}).
       step: optional step number -> saved under path/step_{step}.
+      force: overwrite an existing checkpoint at the target (Orbax's safer
+        default is to refuse; pass True to opt into clobbering).
     Returns the directory written.
     """
     target = os.path.abspath(_step_dir(path, step))

@@ -221,7 +221,8 @@ def main(argv=None):
         print(f"saved global embedding weights to {out}", flush=True)
     if args.checkpoint_dir:
         out = ckpt_lib.save_checkpoint(args.checkpoint_dir,
-                                       {"params": params}, step=steps)
+                                       {"params": params}, step=steps,
+                                       force=True)
         print(f"saved checkpoint to {out}", flush=True)
 
 

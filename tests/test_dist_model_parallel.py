@@ -45,6 +45,7 @@ def ref_apply(weights, inputs, table_map, combiners):
 
 def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
                       seed=0, check_train=True, input_max_hotness=None,
+                      rtol=1e-5, atol=1e-5, train_rtol=1e-4, train_atol=1e-5,
                       **dist_kwargs):
     """specs: list of (vocab, width) or (vocab, width, combiner)."""
     rng = np.random.RandomState(seed)
@@ -84,8 +85,9 @@ def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
 
     assert len(ref_outs) == len(dist_outs)
     for i, (a, b) in enumerate(zip(ref_outs, dist_outs)):
-        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5,
-                                   atol=1e-5, err_msg=f"output {i}")
+        np.testing.assert_allclose(np.asarray(b, np.float32), np.asarray(a),
+                                   rtol=rtol, atol=atol,
+                                   err_msg=f"output {i}")
 
     if not check_train:
         return dist, params
@@ -96,7 +98,8 @@ def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
 
     def dist_loss(p):
         outs = dist.apply(p, inputs)
-        return sum(jnp.vdot(o, c) for o, c in zip(outs, cots))
+        return sum(jnp.vdot(o.astype(jnp.float32), c)
+                   for o, c in zip(outs, cots))
 
     def ref_loss(ws):
         outs = ref_apply(ws, inputs, table_map, combiners)
@@ -110,7 +113,8 @@ def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
 
     got = dist.get_weights(new_params)
     for t, (a, b) in enumerate(zip(new_ref, got)):
-        np.testing.assert_allclose(b, np.asarray(a), rtol=1e-4, atol=1e-5,
+        np.testing.assert_allclose(b, np.asarray(a), rtol=train_rtol,
+                                   atol=train_atol,
                                    err_msg=f"updated table {t}")
     return dist, params
 
@@ -362,6 +366,43 @@ def test_mp_call_dispatch():
                  for rank_ids in dist.strategy.input_ids_list]
     outs = dist(params, mp_inputs)
     assert len(outs) == 8 and outs[0].shape == (BATCH, 8)
+
+
+# ------------------------------------------------------- mixed precision
+# reference parameterizes a mixed_precision_policy over its whole matrix
+# (dist_model_parallel_test.py:30-34); params stay fp32, compute in bf16.
+BF16_TOL = dict(rtol=4e-2, atol=4e-2, train_rtol=4e-2, train_atol=4e-2)
+
+
+def test_bf16_basic():
+    dist, _ = check_equivalence(ONE_HOT_8, strategy="memory_balanced",
+                                compute_dtype=jnp.bfloat16, **BF16_TOL)
+    inputs = [jnp.zeros((BATCH,), jnp.int32)] * 8
+    params = dist.set_weights(
+        [np.zeros((v, w), np.float32) for v, w in ONE_HOT_8])
+    outs = dist.apply(params, inputs)
+    assert all(o.dtype == jnp.bfloat16 for o in outs)
+
+
+def test_bf16_column_slice():
+    check_equivalence(ONE_HOT_8, strategy="memory_balanced",
+                      column_slice_threshold=400,
+                      compute_dtype=jnp.bfloat16, **BF16_TOL)
+
+
+def test_bf16_row_slice():
+    check_equivalence(ONE_HOT_8, strategy="memory_balanced",
+                      row_slice_threshold=1600,
+                      compute_dtype=jnp.bfloat16, **BF16_TOL)
+
+
+def test_bf16_multihot_all_modes():
+    specs = [(10, 4, "sum"), (96, 8, "sum"), (1000, 16, "mean"),
+             (2000, 16, "sum"), (800, 8, "sum")]
+    check_equivalence(specs, strategy="memory_balanced",
+                      column_slice_threshold=400, row_slice_threshold=12800,
+                      data_parallel_threshold=200,
+                      compute_dtype=jnp.bfloat16, **BF16_TOL)
 
 
 def test_cpu_offload_equivalence():
