@@ -25,10 +25,11 @@ import time
 import numpy as np
 import jax
 import jax.numpy as jnp
-import optax
+from jax import lax
 
 from distributed_embeddings_tpu.models.synthetic import (
     SYNTHETIC_MODELS, SyntheticModel, InputGenerator)
+from distributed_embeddings_tpu.training import make_sparse_train_step
 
 BASELINE_TINY_1GPU_MS = 24.433
 BASELINE_BATCH = 65536
@@ -55,46 +56,67 @@ def _init_backend_with_retry(attempts: int = 4, backoff_s: float = 20.0):
 
 
 def _is_oom(e: Exception) -> bool:
-    """True only for genuine device OOM: an XLA runtime error whose status is
-    RESOURCE_EXHAUSTED — not any exception that merely quotes the string."""
+    """True for genuine device OOM. Two shapes observed on hardware:
+    an XLA runtime error with RESOURCE_EXHAUSTED status, and (round-2
+    postmortem) a compile-time HBM overflow surfacing as INTERNAL from the
+    remote-compile tunnel with the allocator report ('Ran out of memory in
+    memory space hbm') in the message body."""
     is_xla_err = type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError")
     try:
         is_xla_err = is_xla_err or isinstance(e, jax.errors.JaxRuntimeError)
     except AttributeError:
         pass
-    return is_xla_err and "RESOURCE_EXHAUSTED" in str(e)
+    msg = str(e)
+    return is_xla_err and ("RESOURCE_EXHAUSTED" in msg
+                           or "Ran out of memory" in msg
+                           or "Attempting to reserve" in msg)
 
 
-def run_at_batch(model, batch, iters=20):
+def run_at_batch(model, batch, iters=10, optimizer="adagrad"):
+    """Steady-state step time via a scanned multi-step program.
+
+    The whole measurement is ONE device program (lax.scan over `iters`
+    steps, batches pre-staged on device), so per-dispatch tunnel latency and
+    async-dispatch ambiguity cannot distort it; wall-clock of the second
+    call / iters is pure device time.
+
+    Training uses the sparse tapped path (make_sparse_train_step): dense
+    table grads for the 4.2 GiB tiny model would not fit 16G HBM and the
+    full-table adagrad pass alone (~21 GiB traffic) exceeds the entire
+    reference step budget.
+    """
     params = model.init(jax.random.PRNGKey(0))
-    opt = optax.adagrad(0.01)
-    opt_state = opt.init(params)
-    gen = InputGenerator(model.config, batch, alpha=1.05, num_batches=4,
+    init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.01)
+    opt_state = init_fn(params)
+    gen = InputGenerator(model.config, batch, alpha=1.05, num_batches=2,
                          seed=0)
+    batches = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[(n, tuple(c), l) for (n, c, l) in gen.batches])
+    nb = len(gen)
 
-    # donation lets XLA update the 4.2 GiB of tables + adagrad accumulators
-    # in place — required to fit batch-65536 training in 16G of HBM
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, numerical, cats, labels):
-        loss, grads = jax.value_and_grad(model.loss_fn)(
-            params, numerical, cats, labels)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = jax.tree.map(lambda p, u: p + u, params, updates)
-        return params, opt_state, loss
+    @functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3,))
+    def run_steps(params, opt_state, batches, n):
+        def body(carry, i):
+            params, opt_state = carry
+            num, cats, labels = jax.tree.map(
+                lambda x: jnp.take(x, i % nb, axis=0), batches)
+            params, opt_state, loss = step_fn(params, opt_state, num,
+                                              list(cats), labels)
+            return (params, opt_state), loss
+        (params, opt_state), losses = lax.scan(
+            body, (params, opt_state), jnp.arange(n))
+        return params, opt_state, losses
 
-    # warmup / compile
-    numerical, cats, labels = gen[0]
-    params, opt_state, loss = train_step(params, opt_state, numerical, cats,
-                                         labels)
-    jax.block_until_ready(loss)
-
+    params, opt_state, losses = run_steps(params, opt_state, batches, iters)
+    jax.block_until_ready(losses)
     t0 = time.perf_counter()
-    for i in range(iters):
-        numerical, cats, labels = gen[i % len(gen)]
-        params, opt_state, loss = train_step(params, opt_state, numerical,
-                                             cats, labels)
-    jax.block_until_ready(loss)
-    return (time.perf_counter() - t0) / iters
+    params, opt_state, losses = run_steps(params, opt_state, batches, iters)
+    jax.block_until_ready(losses)
+    dt = (time.perf_counter() - t0) / iters
+    if not np.isfinite(np.asarray(losses)).all():
+        raise RuntimeError(f"non-finite loss in benchmark: {losses}")
+    return dt
 
 
 # ---------------------------------------------------------------- roofline

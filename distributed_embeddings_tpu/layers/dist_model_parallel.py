@@ -43,7 +43,11 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_embeddings_tpu.ops import embedding_ops, pallas_lookup
+from distributed_embeddings_tpu.ops import sparse_update as sparse_update_ops
 from distributed_embeddings_tpu.ops.embedding_ops import RaggedIds, SparseIds
+from distributed_embeddings_tpu.ops.sparse_update import (SparseOptimizer,
+                                                          SparseRowGrad,
+                                                          concat_grads)
 from distributed_embeddings_tpu.parallel.mesh import DEFAULT_AXIS, create_mesh
 from distributed_embeddings_tpu.parallel.planner import DistEmbeddingStrategy
 from distributed_embeddings_tpu.parallel.plan import ShardedPlan, lower_strategy
@@ -107,6 +111,48 @@ class _ExchangeGroup:
         self.f_max = f_max
         self.need_w = need_w
         self.rank_slots = rank_slots    # per rank: ordered member TPSlots
+
+
+class TapResiduals:
+    """Residuals of a tapped forward pass, consumed by `sparse_update`:
+    per exchange group the post-exchange absolute row ids and effective
+    combine weights (None = uniform; the static scale is recomputed from the
+    group metadata), and per row-sliced input the sentinel-masked local ids +
+    effective weights. Registered as a pytree with the static exchange-group
+    cache key as aux data so `sparse_update` can rebuild the group layout."""
+
+    def __init__(self, key, tp_ids, tp_w, row_ids, row_w):
+        self.key = key          # static: ((k, has_w) per tp input)
+        self.tp_ids = tp_ids    # per group [world, B, f_g, k_g] int32
+        self.tp_w = tp_w        # per group [world, B, f_g, k_g] f32 or None
+        self.row_ids = row_ids  # per row input [world, B, k] int32 (sentinel)
+        self.row_w = row_w      # per row input [world, B, k] f32
+
+    def tree_flatten(self):
+        return ((self.tp_ids, self.tp_w, self.row_ids, self.row_w), self.key)
+
+    @classmethod
+    def tree_unflatten(cls, key, children):
+        return cls(key, *children)
+
+
+jax.tree_util.register_pytree_node(
+    TapResiduals, TapResiduals.tree_flatten, TapResiduals.tree_unflatten)
+
+
+def _effective_weights(weights: Optional[jax.Array], k: int,
+                       combiner: Optional[str]):
+    """Rewrite a (weights, combiner) pair as an explicit weighted SUM:
+    out[b] = scale * sum_k eff_w[b,k] * rows[b,k]  (eff_w None = all-ones).
+    Returns (eff_w, scale). Matches `_combine` semantics exactly."""
+    if combiner is None or combiner == "sum":
+        return weights, 1.0
+    if combiner != "mean":
+        raise ValueError(f"Unknown combiner {combiner}")
+    if weights is None:
+        return None, 1.0 / max(k, 1)
+    denom = jnp.maximum(jnp.sum(weights, axis=-1, keepdims=True), 1.0)
+    return weights / denom, 1.0
 
 
 class DistributedEmbedding:
@@ -197,14 +243,27 @@ class DistributedEmbedding:
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype is not None else None)
         self._groups_cache: dict = {}
+        self._host_fn_cache: dict = {}
+        # physical host offload: buckets past the gpu_embedding_size budget
+        # live in pinned host memory (the reference's /CPU:0 placement,
+        # :829-831); their lookups run in a compute_on("device_host") region
+        # outside the shard_map, streaming only combined rows device-ward.
+        self._offload_enabled = False
         if any(b.offload for b in self.plan.tp_buckets):
-            import warnings
-            warnings.warn(
-                "gpu_embedding_size flagged table(s) for host offload, but "
-                "physical host placement is not wired yet (jax memory-space "
-                "propagation through shard_map): offloaded buckets remain "
-                "device-resident and count against HBM.", RuntimeWarning,
-                stacklevel=2)
+            devs = (list(self.mesh.devices.flat) if self.mesh is not None
+                    else jax.devices())
+            try:
+                kinds = {m.kind for m in devs[0].addressable_memories()}
+            except Exception:  # noqa: BLE001 - backend without memories API
+                kinds = set()
+            self._offload_enabled = "pinned_host" in kinds
+            if not self._offload_enabled:
+                import warnings
+                warnings.warn(
+                    "gpu_embedding_size flagged table(s) for host offload, "
+                    "but this backend exposes no pinned_host memory space: "
+                    "offloaded buckets remain device-resident and count "
+                    "against device memory.", RuntimeWarning, stacklevel=2)
 
     # ------------------------------------------------------------------ init
     def _tp_shard(self, key, b: int, rank: int) -> jax.Array:
@@ -238,7 +297,18 @@ class DistributedEmbedding:
         return [(flat.index(d), d) for d in flat
                 if d.process_index == jax.process_index()]
 
-    def _stack_sharded(self, shard_fn) -> jax.Array:
+    def _bucket_memory_kind(self, b: int) -> Optional[str]:
+        """'pinned_host' for physically-offloaded buckets, else None."""
+        if self._offload_enabled and self.plan.tp_buckets[b].offload:
+            return "pinned_host"
+        return None
+
+    def _param_sharding(self, memory_kind: Optional[str] = None):
+        kw = {"memory_kind": memory_kind} if memory_kind else {}
+        return NamedSharding(self.mesh, P(self.axis), **kw)
+
+    def _stack_sharded(self, shard_fn,
+                       memory_kind: Optional[str] = None) -> jax.Array:
         """Assemble a [world, rows_max, w] P(axis)-sharded array by computing
         (or staging) each rank's shard directly on that rank's device — peak
         staging is one shard, never the global stack (round-1 gap: the
@@ -246,16 +316,20 @@ class DistributedEmbedding:
         CPU-inits to dodge init OOM, embedding.py:28-47).
 
         shard_fn(rank) -> [rows_max, w] array-like for that rank.
+        memory_kind='pinned_host' stages each shard into that rank's host
+        memory (offloaded buckets — reference /CPU:0 build, :1186-1189).
         """
         shards, shape = [], None
         for rank, dev in self._rank_of_device():
             with jax.default_device(dev):
                 shard = jnp.asarray(shard_fn(rank))[None]
-            shard = jax.device_put(shard, dev)
+            target = (jax.sharding.SingleDeviceSharding(
+                dev, memory_kind=memory_kind) if memory_kind else dev)
+            shard = jax.device_put(shard, target)
             shards.append(shard)
             shape = shard.shape
         global_shape = (self.world_size,) + tuple(shape[1:])
-        sharding = NamedSharding(self.mesh, P(self.axis))
+        sharding = self._param_sharding(memory_kind)
         return jax.make_array_from_single_device_arrays(
             global_shape, sharding, shards)
 
@@ -283,14 +357,20 @@ class DistributedEmbedding:
             row_init = jax.jit(self._row_shard, static_argnums=(1, 2))
             for b in range(len(self.plan.tp_buckets)):
                 params["tp"].append(self._stack_sharded(
-                    lambda rank, b=b: tp_init(kt, b, rank)))
+                    lambda rank, b=b: tp_init(kt, b, rank),
+                    memory_kind=self._bucket_memory_kind(b)))
             for t in range(len(self.plan.row_tables)):
                 params["row"].append(self._stack_sharded(
                     lambda rank, t=t: row_init(kr, t, rank)))
         else:
             for b in range(len(self.plan.tp_buckets)):
-                params["tp"].append(jnp.stack(
-                    [self._tp_shard(kt, b, r) for r in range(self.world_size)]))
+                arr = jnp.stack(
+                    [self._tp_shard(kt, b, r) for r in range(self.world_size)])
+                mk = self._bucket_memory_kind(b)
+                if mk:
+                    arr = jax.device_put(arr, jax.sharding.SingleDeviceSharding(
+                        jax.devices()[0], memory_kind=mk))
+                params["tp"].append(arr)
             for t in range(len(self.plan.row_tables)):
                 params["row"].append(jnp.stack(
                     [self._row_shard(kr, t, r) for r in range(self.world_size)]))
@@ -299,21 +379,23 @@ class DistributedEmbedding:
     def param_shardings(self, mesh: Optional[Mesh] = None) -> dict:
         """NamedSharding pytree matching `init` output — for pjit/device_put.
 
-        Offload status: buckets flagged by the planner's gpu_embedding_size
-        budget (reference _maybe_offload :449-476) are kept in separate
-        buckets so they can be placed/streamed independently; physical
-        pinned-host placement is not wired yet — as of jax 0.9, XLA's
-        memory-space propagation does not reach through shard_map bodies, so
-        host-resident tables cannot participate in the SPMD forward.
-        """
+        Buckets past the gpu_embedding_size budget carry
+        memory_kind='pinned_host' (reference _maybe_offload :449-476 +
+        /CPU:0 build :1186-1189): they live in host RAM and their lookups run
+        host-side, outside the shard_map (XLA memory-space propagation does
+        not reach through shard_map bodies as of jax 0.9)."""
         mesh = mesh or self.mesh
         if mesh is None:
             raise ValueError("No mesh bound")
         rep = NamedSharding(mesh, P())
         shard0 = NamedSharding(mesh, P(self.axis))
+        def tp_shard(b):
+            mk = self._bucket_memory_kind(b)
+            return (NamedSharding(mesh, P(self.axis), memory_kind=mk)
+                    if mk else shard0)
         return {
             "dp": [rep for _ in self.strategy.dp_configs],
-            "tp": [shard0 for _ in self.plan.tp_buckets],
+            "tp": [tp_shard(b) for b in range(len(self.plan.tp_buckets))],
             "row": [shard0 for _ in self.plan.row_tables],
         }
 
@@ -365,6 +447,12 @@ class DistributedEmbedding:
         Cached per hotness/weights signature (one entry per jit trace shape).
         """
         key = tuple((p.k, p.weights is not None) for p in tp_prep)
+        return self._exchange_groups_for_key(key)
+
+    def _exchange_groups_for_key(self, key):
+        """Same as `_exchange_groups` but from the static (k, has_weights)
+        signature alone — lets `sparse_update` rebuild the exact group layout
+        a tapped forward used, via TapResiduals.key."""
         hit = self._groups_cache.get(key)
         if hit is not None:
             return hit
@@ -374,7 +462,7 @@ class DistributedEmbedding:
         for b, bucket in enumerate(self.plan.tp_buckets):
             for r, slots in enumerate(bucket.slots):
                 for j, s in enumerate(slots):
-                    k = tp_prep[s.tp_input].k
+                    k = key[s.tp_input][0]
                     if (b, k) not in per_bk:
                         per_bk[(b, k)] = [[] for _ in range(world)]
                         order.append((b, k))
@@ -396,7 +484,7 @@ class DistributedEmbedding:
                     offs[r, j_g] = s.row_offset
                     slot_map[(b, r, j)] = (g, j_g)
                 rank_slots.append([s for (_, s) in lst])
-            need_w = any(tp_prep[i].weights is not None for i in class_inputs)
+            need_w = any(key[i][1] for i in class_inputs)
             groups.append(_ExchangeGroup(b, k, class_inputs, sel, offs,
                                          f_max, need_w, rank_slots))
         assembly = [
@@ -407,20 +495,16 @@ class DistributedEmbedding:
         return res
 
     def _group_lookup(self, table: jax.Array, ids: jax.Array,
-                      weights: Optional[jax.Array], combiner: Optional[str],
-                      offload: bool) -> jax.Array:
+                      weights: Optional[jax.Array],
+                      combiner: Optional[str]) -> jax.Array:
         """Local fused-bucket lookup + combine: ids [B, f, k] -> [B, f, wf].
 
         Multi-hot sum/mean groups route through the Pallas fused kernel on
         TPU (the hot-loop equivalent of the reference's CUDA combiner,
         cu:175-336); everything else is XLA gather + reduce, which XLA fuses.
-
-        `offload` marks buckets past the gpu_embedding_size budget; a true
-        host-side gather (only looked-up rows crossing the host link, the
-        reference's /CPU:0 lookup :829-831) needs memory-space propagation
-        through shard_map, not available as of jax 0.9 — device-side for now.
+        (Offloaded buckets never reach here — their lookups run host-side in
+        `_host_group_exchange`.)
         """
-        del offload
         b_sz, f, k = ids.shape
         if (combiner in ("sum", "mean") and k > 1 and self.use_custom_kernel
                 and pallas_lookup.is_tpu_backend()):
@@ -451,7 +535,8 @@ class DistributedEmbedding:
         return jnp.take(jnp.asarray(const), self._my_index(), axis=0)
 
     def _forward_local(self, dp_params, tp_params, row_params,
-                       dp_in, group_ids, group_w, row_in, groups):
+                       dp_in, group_ids, group_w, row_in, groups,
+                       taps=None, want_res=False):
         """The per-device forward (shard_map body when world > 1).
 
         Args:
@@ -459,11 +544,22 @@ class DistributedEmbedding:
           group_ids: per exchange group, stacked ids [B_l, n_g, k_g].
           group_w: matching weights [B_l, n_g, k_g] or None per group.
           groups: the static _ExchangeGroup records.
+          taps: optional {'tp': [[1, B, f, w_out]...], 'row': [...]} zero
+            arrays added to each bucket-lookup / row-partial output; their
+            cotangents under autodiff are exactly the per-device output
+            gradients `sparse_update` consumes (no dense table grads).
+          want_res: also return TapResiduals arrays (post-exchange ids +
+            effective weights).
 
-        Returns (dp_outs, ex_list, row_outs):
+        Returns (dp_outs, ex_list, row_outs, off_ids, off_w, res):
           dp_outs: [B_l, w] (or [B_l, K, w]) per dp input
-          ex_list: per group [world_src, B_l, f_max_g, wf]
+          ex_list: per group [world_src, B_l, f_max_g, wf]; None at offloaded
+            groups (filled by the caller via _host_group_exchange)
           row_outs: [B_l, ...] partial sums scattered over batch.
+          off_ids / off_w: per group the exchanged ids / effective weights
+            ([1, ...]-stacked) for offloaded groups, None elsewhere.
+          res: (tp_ids, tp_w, row_ids, row_w) lists ([1, ...]-stacked) or
+            None when want_res is False.
         """
         world = self.world_size
         strat = self.strategy
@@ -481,6 +577,10 @@ class DistributedEmbedding:
         # receives only ids for features it owns (reference hvd.alltoall
         # with splits, :211) — not an all_gather of everything.
         ex_list = []
+        off_ids: List[Optional[jax.Array]] = []
+        off_w: List[Optional[jax.Array]] = []
+        tp_res_ids: List[jax.Array] = []
+        tp_res_w: List[Optional[jax.Array]] = []
         for g, grp in enumerate(groups):
             ids = group_ids[g]                               # [B_l, n_g, k]
             blocal = ids.shape[0]
@@ -508,13 +608,119 @@ class DistributedEmbedding:
             offs = self._device_const(grp.offs)              # [f_max]
             ids_x = ids_x + offs[None, :, None].astype(ids_x.dtype)
             bucket = self.plan.tp_buckets[grp.bucket]
-            out = self._group_lookup(tp_params[grp.bucket][0], ids_x, w_x,
-                                     bucket.combiner, bucket.offload)
-            ex_list.append(self._tp_bucket_exchange(out))
+            offloaded = bucket.offload and self._offload_enabled
+            if offloaded:
+                # id exchange happens on-device (above); the lookup itself
+                # runs host-side outside the shard_map (reference /CPU:0
+                # lookup :829-831) — export the exchanged ids/weights
+                eff_w, _ = _effective_weights(w_x, grp.k, bucket.combiner)
+                off_ids.append(ids_x[None].astype(jnp.int32))
+                off_w.append(None if eff_w is None else eff_w[None])
+                ex_list.append(None)
+            else:
+                off_ids.append(None)
+                off_w.append(None)
+                out = self._tp_group_out(
+                    tp_params, grp, ids_x, w_x,
+                    None if taps is None else taps["tp"][g])
+                ex_list.append(self._tp_bucket_exchange(out))
+            if want_res:
+                eff_w, _ = _effective_weights(w_x, grp.k, bucket.combiner)
+                tp_res_ids.append(ids_x[None].astype(jnp.int32))
+                tp_res_w.append(None if eff_w is None else eff_w[None])
 
         # ---- row-sliced tables: all_gather ids, masked lookup, psum_scatter
-        row_outs = self._row_slice_local(row_params, row_in)
-        return dp_outs, ex_list, row_outs
+        row_outs, row_res = self._row_slice_local(
+            row_params, row_in,
+            None if taps is None else taps["row"], want_res)
+        res = ((tp_res_ids, tp_res_w) + row_res) if want_res else None
+        return dp_outs, ex_list, row_outs, off_ids, off_w, res
+
+    def _tp_group_out(self, tp_params, grp, ids_x, w_x, tap):
+        """One exchange group's local bucket output [B, f, w_out], via the
+        explicit weighted-sum form (so tapped and untapped paths share
+        numerics), plus the optional tap perturbation."""
+        bucket = self.plan.tp_buckets[grp.bucket]
+        eff_w, scale = _effective_weights(w_x, grp.k, bucket.combiner)
+        out = self._group_lookup(
+            tp_params[grp.bucket][0], ids_x, eff_w,
+            None if bucket.combiner is None else "sum")
+        if scale != 1.0:
+            out = out * jnp.asarray(scale, out.dtype)
+        if tap is not None:
+            out = out + tap[0].astype(out.dtype)
+        return out
+
+    def _host_group_exchange(self, table_h: jax.Array, grp, ids_g, w_g, tap,
+                             g: int):
+        """Offloaded-bucket lookup: gather+combine in pinned host memory
+        (compute_on 'device_host'), stream only combined [B, f, w_out] rows
+        to the device, then reshard owner-major -> batch-major (the GSPMD
+        form of the mp->dp all_to_all). Output layout matches
+        `_tp_bucket_exchange` exactly. Reference: /CPU:0 tables with native
+        kernels (dist_model_parallel.py:829-831).
+
+        ids_g: [world, B, f, k] device-sharded exchanged absolute rows;
+        w_g: matching effective weights or None; tap: optional perturbation.
+        """
+        bucket = self.plan.tp_buckets[grp.bucket]
+        world = self.world_size
+        k, wf = grp.k, bucket.width
+        key = (g, ids_g.shape, None if w_g is None else w_g.shape,
+               None if tap is None else tap.shape)
+        fn = self._host_fn_cache.get(key)
+        if fn is None:
+            combiner = bucket.combiner
+            # the static mean scale applies only to the uniform-weights case;
+            # explicit weights arrive already normalized (_effective_weights'
+            # scale-1.0 branch) — mirroring _tp_group_out exactly
+            if w_g is None:
+                _, scale = _effective_weights(None, k, combiner)
+            else:
+                scale = 1.0
+            rows_max = max(bucket.rows_max, 1)
+            if self.mesh is not None:
+                host_sh = lambda: NamedSharding(self.mesh, P(self.axis),
+                                                memory_kind="pinned_host")
+                dev_sh = NamedSharding(self.mesh, P(self.axis))
+            else:
+                dev0 = jax.devices()[0]
+                host_sh = lambda: jax.sharding.SingleDeviceSharding(
+                    dev0, memory_kind="pinned_host")
+                dev_sh = jax.sharding.SingleDeviceSharding(dev0)
+
+            def run(table_h, ids_g, w_g, tap):
+                B, f = ids_g.shape[1], ids_g.shape[2]
+                ids = jnp.clip(ids_g, 0, rows_max - 1).reshape(world, -1)
+                ids_h = jax.device_put(ids, host_sh())
+                w_h = (None if w_g is None
+                       else jax.device_put(
+                           w_g.reshape(world, B * f, k), host_sh()))
+                from jax.experimental import compute_on
+                with compute_on.compute_on("device_host"):
+                    rows = jax.vmap(sparse_update_ops.take_rows)(
+                        table_h, ids_h)                    # [world, N, wf]
+                    if combiner is None:
+                        out_h = rows.reshape(world, B, f, k * wf)
+                    else:
+                        rows = rows.reshape(world, B * f, k, wf)
+                        out_h = (rows if w_h is None
+                                 else rows * w_h[..., None]).sum(axis=2)
+                        out_h = out_h.reshape(world, B, f, wf)
+                out = jax.device_put(out_h, dev_sh)
+                out = self._cast(out)
+                if scale != 1.0:
+                    out = out * jnp.asarray(scale, out.dtype)
+                if tap is not None:
+                    out = out + tap.astype(out.dtype)
+                if self.mesh is not None and world > 1:
+                    out = lax.with_sharding_constraint(
+                        out, NamedSharding(self.mesh, P(None, self.axis)))
+                return out
+
+            fn = jax.jit(run)
+            self._host_fn_cache[key] = fn
+        return fn(table_h, ids_g, w_g, tap)
 
     def _tp_bucket_exchange(self, out: jax.Array) -> jax.Array:
         """mp->dp movement of one bucket's outputs: [B, f, wf] ->
@@ -526,10 +732,13 @@ class DistributedEmbedding:
             return lax.all_to_all(x, self.axis, split_axis=0, concat_axis=0)
         return out[None]
 
-    def _row_slice_local(self, row_params, row_in):
+    def _row_slice_local(self, row_params, row_in, row_taps=None,
+                         want_res=False):
         world = self.world_size
         strat = self.strategy
         row_outs = []
+        res_ids: List[jax.Array] = []
+        res_w: List[jax.Array] = []
         for j, (ids, weights) in enumerate(row_in):
             t = strat.map_groups[2][j]
             rt = self.plan.row_tables[t]
@@ -544,34 +753,52 @@ class DistributedEmbedding:
             local = jnp.clip(local, 0, max(rt.rows_max - 1, 0))
             table = row_params[t][0]
             emb = self._cast(jnp.take(table, local, axis=0))
-            emb = emb * valid[..., None].astype(emb.dtype)
+            vmask = valid.astype(jnp.float32)
+            # explicit weighted-sum form (see _effective_weights): the valid
+            # mask folds into the weights so the tapped backward sees the
+            # exact per-contribution coefficients
+            eff_w, scale = _effective_weights(weights, ids.shape[-1],
+                                              rt.combiner)
+            w_full = vmask if eff_w is None else eff_w * vmask
             if rt.combiner is None:
-                out = emb                                          # [B, k, w]
-            elif weights is None:
-                out = (jnp.sum(emb, axis=-2) if rt.combiner == "sum"
-                       else jnp.mean(emb, axis=-2))
+                out = emb * vmask[..., None].astype(emb.dtype)     # [B, k, w]
             else:
-                out = jnp.einsum("bk,bkw->bw", weights.astype(emb.dtype), emb)
-                if rt.combiner == "mean":
-                    denom = jnp.maximum(jnp.sum(weights, axis=-1), 1.0)
-                    out = out / denom[:, None].astype(out.dtype)
+                out = jnp.einsum("bk,bkw->bw", w_full.astype(emb.dtype), emb)
+                if scale != 1.0:
+                    out = out * jnp.asarray(scale, out.dtype)
+            if row_taps is not None:
+                out = out + row_taps[j][0].astype(out.dtype)
             if world > 1:
                 out = lax.psum_scatter(out, self.axis, scatter_dimension=0,
                                        tiled=True)
             row_outs.append(out)
-        return row_outs
+            if want_res:
+                # OOB sentinel rows_max: dropped by the sparse scatter
+                sent = jnp.where(valid, local, rt.rows_max).astype(jnp.int32)
+                res_ids.append(sent[None])
+                res_w.append((w_full * scale)[None])
+        return row_outs, (res_ids, res_w)
 
-    def apply(self, params: dict, inputs: Sequence) -> List[jax.Array]:
+    def apply(self, params: dict, inputs: Sequence, taps=None,
+              return_residuals: bool = False):
         """Forward pass with data-parallel input.
 
         Args:
           params: pytree from `init` (or `set_weights`).
           inputs: one per feature — global-batch arrays [B] / [B, k],
             RaggedIds, SparseIds or (ids, weights) tuples.
+          taps: optional zero pytree from `make_taps(inputs)`. When supplied,
+            differentiating the loss w.r.t. `taps` yields the per-device
+            bucket-output gradients that `sparse_update` turns into row-wise
+            table updates — the TPU equivalent of the reference's sparse
+            IndexedSlices backward (embedding_lookup_ops.py:105-122), with
+            no dense [V, w] gradient ever materialized.
+          return_residuals: also return the TapResiduals for `sparse_update`.
 
         Returns:
           One [B, width] array per input (or [B, k, width] for combiner=None
           multi-hot), in input order — batch-sharded over the mesh.
+          With return_residuals, a (outputs, TapResiduals) tuple.
         """
         if not self.dp_input:
             raise ValueError("This layer was built with dp_input=False; "
@@ -611,32 +838,72 @@ class DistributedEmbedding:
         dp_in = [(p.ids, p.weights) for p in dp_prep]
         row_in = [(p.ids, p.weights) for p in row_prep]
 
+        want_res = bool(return_residuals)
+        offloaded_groups = [
+            g for g, grp in enumerate(groups)
+            if self.plan.tp_buckets[grp.bucket].offload
+            and self._offload_enabled]
+        # taps of offloaded groups are applied outside the shard_map (at the
+        # host-lookup output); mask them from the inner forward
+        inner_taps = taps
+        if taps is not None and offloaded_groups:
+            inner_taps = {
+                "tp": [None if g in offloaded_groups else t
+                       for g, t in enumerate(taps["tp"])],
+                "row": taps["row"]}
         if world > 1:
             specs = lambda tree, spec: jax.tree.map(lambda _: spec, tree)
             args = (params["dp"], params["tp"], params["row"],
-                    dp_in, group_ids, group_w, row_in)
+                    dp_in, group_ids, group_w, row_in, inner_taps)
             in_specs = (specs(params["dp"], P()),
                         specs(params["tp"], P(self.axis)),
                         specs(params["row"], P(self.axis)),
                         specs(dp_in, P(self.axis)),
                         specs(group_ids, P(self.axis)),
                         specs(group_w, P(self.axis)),
-                        specs(row_in, P(self.axis)))
+                        specs(row_in, P(self.axis)),
+                        specs(inner_taps, P(self.axis)))
+            off_id_specs = [P(self.axis) if g in offloaded_groups else None
+                            for g in range(len(groups))]
+            off_w_specs = [
+                (P(self.axis) if (g in offloaded_groups
+                                  and group_w[g] is not None) else None)
+                for g in range(len(groups))]
             out_specs = (
                 [P(self.axis)] * len(dp_in),
-                [P(None, self.axis)] * len(groups),
+                [None if g in offloaded_groups else P(None, self.axis)
+                 for g in range(len(groups))],
                 [P(self.axis)] * len(row_in),
+                off_id_specs,
+                off_w_specs,
             )
-            dp_outs, ex_list, row_outs = jax.shard_map(
-                lambda d, t, r, di, gi, gw, ri: self._forward_local(
-                    d, t, r, di, gi, gw, ri, groups),
-                mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            res_specs = ((
+                [P(self.axis)] * len(groups),
+                [None if g is None else P(self.axis)
+                 for g in group_w],
+                [P(self.axis)] * len(row_in),
+                [P(self.axis)] * len(row_in)) if want_res else None,)
+            dp_outs, ex_list, row_outs, off_ids, off_w, res = jax.shard_map(
+                lambda d, t, r, di, gi, gw, ri, tp: self._forward_local(
+                    d, t, r, di, gi, gw, ri, groups, taps=tp,
+                    want_res=want_res),
+                mesh=self.mesh, in_specs=in_specs,
+                out_specs=out_specs + res_specs,
                 check_vma=False,
             )(*args)
         else:
-            dp_outs, ex_list, row_outs = self._forward_local(
-                params["dp"], params["tp"], params["row"],
-                dp_in, group_ids, group_w, row_in, groups)
+            dp_outs, ex_list, row_outs, off_ids, off_w, res = (
+                self._forward_local(
+                    params["dp"], params["tp"], params["row"],
+                    dp_in, group_ids, group_w, row_in, groups,
+                    taps=inner_taps, want_res=want_res))
+
+        # offloaded buckets: host-side lookup + GSPMD exchange
+        for g in offloaded_groups:
+            grp = groups[g]
+            tap_g = taps["tp"][g] if taps is not None else None
+            ex_list[g] = self._host_group_exchange(
+                params["tp"][grp.bucket], grp, off_ids[g], off_w[g], tap_g, g)
 
         # ---- assemble per-input outputs ------------------------------------
         dp_final = []
@@ -656,7 +923,11 @@ class DistributedEmbedding:
             row_final.append(self._restore_shape(out, p, rt.combiner, rt.width))
 
         outputs = dp_final + tp_final + row_final
-        return [outputs[idx] for idx in strat.rev_group_ids]
+        outputs = [outputs[idx] for idx in strat.rev_group_ids]
+        if want_res:
+            key = tuple((p.k, p.weights is not None) for p in tp_prep)
+            return outputs, TapResiduals(key, res[0], res[1], res[2], res[3])
+        return outputs
 
     def _assemble_tp_outputs(self, ex_list, tp_preps, batch, groups,
                              assembly) -> List[jax.Array]:
@@ -686,7 +957,8 @@ class DistributedEmbedding:
                                                 out.shape[-1]))
         return tp_final
 
-    def apply_mp(self, params: dict, inputs) -> List[jax.Array]:
+    def apply_mp(self, params: dict, inputs, taps=None,
+                 return_residuals: bool = False):
         """Forward pass with model-parallel input (dp_input=False).
 
         The reference mp-input contract (:729-731, :846-851): each rank
@@ -864,35 +1136,425 @@ class DistributedEmbedding:
                 group_w.append(jnp.stack([b[1] for b in blocks])
                                if grp.need_w else None)
 
-        def body(tp_params, group_ids, group_w):
-            ex_list = []
+        offloaded_groups = [
+            g for g, grp in enumerate(groups)
+            if self.plan.tp_buckets[grp.bucket].offload
+            and self._offload_enabled]
+        inner_taps = taps
+        if taps is not None and offloaded_groups:
+            inner_taps = {"tp": [None if g in offloaded_groups else t
+                                 for g, t in enumerate(taps["tp"])],
+                          "row": taps.get("row", [])}
+
+        def body(tp_params, group_ids, group_w, taps_l):
+            ex_list, off_ids, off_w = [], [], []
+            res_ids, res_w = [], []
             for g, grp in enumerate(groups):
                 ids_l = group_ids[g][0]                         # [B, f, k]
                 offs = self._device_const(grp.offs)
                 ids_l = ids_l + offs[None, :, None].astype(ids_l.dtype)
                 w_l = group_w[g][0] if group_w[g] is not None else None
                 bucket = self.plan.tp_buckets[grp.bucket]
-                out = self._group_lookup(tp_params[grp.bucket][0], ids_l,
-                                         w_l, bucket.combiner, bucket.offload)
-                ex_list.append(self._tp_bucket_exchange(out))
-            return ex_list
+                if g in offloaded_groups:
+                    eff_w, _ = _effective_weights(w_l, grp.k, bucket.combiner)
+                    off_ids.append(ids_l[None].astype(jnp.int32))
+                    off_w.append(None if eff_w is None else eff_w[None])
+                    ex_list.append(None)
+                else:
+                    off_ids.append(None)
+                    off_w.append(None)
+                    out = self._tp_group_out(
+                        tp_params, grp, ids_l, w_l,
+                        None if taps_l is None else taps_l["tp"][g])
+                    ex_list.append(self._tp_bucket_exchange(out))
+                if return_residuals:
+                    eff_w, _ = _effective_weights(w_l, grp.k, bucket.combiner)
+                    res_ids.append(ids_l[None].astype(jnp.int32))
+                    res_w.append(None if eff_w is None else eff_w[None])
+            res = (res_ids, res_w) if return_residuals else None
+            return ex_list, off_ids, off_w, res
 
         if world > 1:
             specs = lambda tree, spec: jax.tree.map(lambda _: spec, tree)
-            ex_list = jax.shard_map(
+            out_specs = (
+                [None if g in offloaded_groups else P(None, self.axis)
+                 for g in range(len(groups))],
+                [P(self.axis) if g in offloaded_groups else None
+                 for g in range(len(groups))],
+                [(P(self.axis) if (g in offloaded_groups
+                                   and group_w[g] is not None) else None)
+                 for g in range(len(groups))],
+                (([P(self.axis)] * len(groups),
+                  [None if g is None else P(self.axis) for g in group_w])
+                 if return_residuals else None),
+            )
+            ex_list, off_ids, off_w, res = jax.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(specs(params["tp"], P(self.axis)),
                           specs(group_ids, P(self.axis)),
-                          specs(group_w, P(self.axis))),
-                out_specs=[P(None, self.axis)] * len(groups),
+                          specs(group_w, P(self.axis)),
+                          specs(inner_taps, P(self.axis))),
+                out_specs=out_specs,
                 check_vma=False,
-            )(params["tp"], group_ids, group_w)
+            )(params["tp"], group_ids, group_w, inner_taps)
         else:
-            ex_list = body(params["tp"], group_ids, group_w)
+            ex_list, off_ids, off_w, res = body(params["tp"], group_ids,
+                                                group_w, inner_taps)
+
+        for g in offloaded_groups:
+            grp = groups[g]
+            tap_g = taps["tp"][g] if taps is not None else None
+            ex_list[g] = self._host_group_exchange(
+                params["tp"][grp.bucket], grp, off_ids[g], off_w[g], tap_g, g)
 
         outputs = self._assemble_tp_outputs(ex_list, tp_preps, batch,
                                             groups, assembly)
-        return [outputs[idx] for idx in strat.rev_group_ids]
+        outputs = [outputs[idx] for idx in strat.rev_group_ids]
+        if return_residuals:
+            key = tuple((p.k, p.weights is not None) for p in tp_preps)
+            return outputs, TapResiduals(key, res[0], res[1], [], [])
+        return outputs
+
+    # ------------------------------------------------- sparse training path
+    def make_taps(self, inputs) -> dict:
+        """Zero perturbation pytree for `apply(..., taps=...)`: one
+        [world, B, f_max_g, w_out] array per exchange group and one
+        [world, B, (k,) w] array per row-sliced input. Create inside the
+        jitted train step — XLA folds the zero adds away in the forward while
+        autodiff still delivers their cotangents."""
+        if not self.dp_input:
+            raise NotImplementedError(
+                "make_taps currently supports dp_input=True; for mp-input "
+                "training, construct per-group taps matching apply_mp's "
+                "exchange groups directly")
+        prepped = self._prepare_inputs(inputs)
+        strat = self.strategy
+        batch = prepped[0].ids.shape[0]
+        dtype = self.compute_dtype or jnp.float32
+        tp_prep = [prepped[i] for i in strat.input_groups[1]]
+        taps = {"tp": [], "row": []}
+        if tp_prep:
+            groups, _ = self._exchange_groups(tp_prep)
+            for grp in groups:
+                bucket = self.plan.tp_buckets[grp.bucket]
+                w_out = (bucket.width if bucket.combiner is not None
+                         else bucket.width * grp.k)
+                taps["tp"].append(jnp.zeros(
+                    (self.world_size, batch, grp.f_max, w_out), dtype))
+        for pos, j in enumerate(strat.input_groups[2]):
+            p = prepped[j]
+            rt = self.plan.row_tables[strat.map_groups[2][pos]]
+            shape = ((self.world_size, batch, rt.width)
+                     if rt.combiner is not None
+                     else (self.world_size, batch, p.k, rt.width))
+            taps["row"].append(jnp.zeros(shape, dtype))
+        return taps
+
+    def _state_spec(self, leaf):
+        """Sharding spec rule for sparse-optimizer state leaves: table-shaped
+        stacked arrays ([world, rows, w]) shard over the axis, scalars (adam
+        step count) replicate."""
+        return P(self.axis) if getattr(leaf, "ndim", 0) == 3 else P()
+
+    def _group_contrib(self, g, grp, res_tp_ids, res_tp_w, tp_g,
+                       stacked: bool) -> SparseRowGrad:
+        """Build one exchange group's SparseRowGrad from residual ids /
+        effective weights and the tap gradient. stacked=False squeezes the
+        leading [1] device axis (shard_map body); True keeps the [world]
+        axis (global host-offload path)."""
+        bucket = self.plan.tp_buckets[grp.bucket]
+        ids_x = res_tp_ids[g] if stacked else res_tp_ids[g][0]
+        gtap = tp_g[g] if stacked else tp_g[g][0]
+        k, wf = grp.k, bucket.width
+        lead = gtap.shape[:-1]                        # [..., B, f]
+        if bucket.combiner is None:
+            gk = gtap.reshape(lead + (k, wf))
+        else:
+            gk = gtap[..., None, :]
+        eff = res_tp_w[g]
+        if eff is None:
+            _, scale = _effective_weights(None, k, bucket.combiner)
+            contrib = jnp.broadcast_to(gk.astype(jnp.float32) * scale,
+                                       ids_x.shape + (wf,))
+        else:
+            eff = eff if stacked else eff[0]
+            contrib = gk.astype(jnp.float32) * eff[..., None]
+        if stacked:
+            world = ids_x.shape[0]
+            return SparseRowGrad(ids_x.reshape(world, -1),
+                                 contrib.reshape(world, -1, wf))
+        return SparseRowGrad(ids_x.reshape(-1), contrib.reshape(-1, wf))
+
+    def _sparse_update_body(self, tp_params, row_params, tp_states,
+                            row_states, tp_g, row_g, res_tp_ids, res_tp_w,
+                            res_row_ids, res_row_w, groups, opt,
+                            dev_buckets):
+        """Per-device sparse updates (stacked [1, rows, w] shards in/out).
+        tp_params/tp_states hold only the non-offloaded buckets, in
+        dev_buckets order."""
+
+        def split_state(state):
+            return tuple(x[0] if getattr(x, "ndim", 0) == 3 else x
+                         for x in state)
+
+        def stack_state(state):
+            return tuple(x[None] if getattr(x, "ndim", 0) == 2 else x
+                         for x in state)
+
+        bucket_groups: dict = {}
+        for g, grp in enumerate(groups):
+            bucket_groups.setdefault(grp.bucket, []).append(g)
+
+        new_tp, new_tp_s = [], []
+        for pos, b in enumerate(dev_buckets):
+            grads = [self._group_contrib(g, groups[g], res_tp_ids, res_tp_w,
+                                         tp_g, stacked=False)
+                     for g in bucket_groups.get(b, [])]
+            if not grads:
+                new_tp.append(tp_params[pos])
+                new_tp_s.append(tp_states[pos])
+                continue
+            t_new, s_new = opt.update(tp_params[pos][0],
+                                      split_state(tp_states[pos]),
+                                      concat_grads(grads))
+            new_tp.append(t_new[None])
+            new_tp_s.append(stack_state(s_new))
+
+        # row-sliced tables: multiple inputs may share one table
+        table_inputs: dict = {}
+        for j in range(len(res_row_ids)):
+            t = self.strategy.map_groups[2][j]
+            table_inputs.setdefault(t, []).append(j)
+        new_row = list(row_params)
+        new_row_s = list(row_states)
+        for t, js in table_inputs.items():
+            rt = self.plan.row_tables[t]
+            grads = []
+            for j in js:
+                ids = res_row_ids[j][0]                   # [B, k]
+                w = res_row_w[j][0]                       # [B, k]
+                gtap = row_g[j][0]                        # [B, w] | [B, k, w]
+                gk = (gtap[:, None, :] if rt.combiner is not None else gtap)
+                contrib = gk.astype(jnp.float32) * w[..., None]
+                grads.append(SparseRowGrad(
+                    ids.reshape(-1), contrib.reshape(-1, rt.width)))
+            t_new, s_new = opt.update(row_params[t][0],
+                                      split_state(row_states[t]),
+                                      concat_grads(grads))
+            new_row[t] = t_new[None]
+            new_row_s[t] = stack_state(s_new)
+        return new_tp, new_row, new_tp_s, new_row_s
+
+    def init_sparse_state(self, params: dict, opt: SparseOptimizer) -> dict:
+        """Sparse-optimizer state for the tp/row tables (dp tables train
+        dense). Table-shaped state leaves (adagrad accumulator, adam moments)
+        are created directly with the tables' shardings — never materialized
+        unsharded (the init-OOM concern behind the reference's CPU-side init,
+        embedding.py:28-47)."""
+        def init_host(stack):
+            # constant-fill leaves staged shard-wise straight into pinned
+            # host memory via numpy (XLA cannot emit host-placed outputs on
+            # every backend, and a device-side init would need HBM the
+            # offloaded bucket was too big for in the first place)
+            tiny = opt.init(jnp.zeros((1, stack.shape[-1]), stack.dtype))
+            out = []
+            for x in tiny:
+                if getattr(x, "ndim", 0) == 2:
+                    fill = float(np.asarray(x)[0, 0])
+                    if self.mesh is None:
+                        host = jax.sharding.SingleDeviceSharding(
+                            jax.devices()[0], memory_kind="pinned_host")
+                        out.append(jax.device_put(
+                            np.full(stack.shape, fill, np.float32), host))
+                    else:
+                        out.append(self._stack_sharded(
+                            lambda rank: np.full(stack.shape[1:], fill,
+                                                 np.float32),
+                            memory_kind="pinned_host"))
+                else:
+                    out.append(x)
+            return tuple(out)
+
+        def init_one(stack, memory_kind=None):
+            if memory_kind:
+                return init_host(stack)
+            if self.mesh is None:
+                return opt.init(stack)
+            shard = NamedSharding(self.mesh, P(self.axis))
+            rep = NamedSharding(self.mesh, P())
+            probe = jax.eval_shape(opt.init, stack)
+            out_sh = tuple(shard if x.ndim == 3 else rep for x in probe)
+            return jax.jit(opt.init, out_shardings=out_sh)(stack)
+        return {"tp": [init_one(t, self._bucket_memory_kind(b))
+                       for b, t in enumerate(params["tp"])],
+                "row": [init_one(t) for t in params["row"]]}
+
+    def sparse_update(self, params: dict, opt_states: dict, tap_grads: dict,
+                      residuals: "TapResiduals", opt: SparseOptimizer):
+        """Row-wise sparse optimizer step for tp/row tables.
+
+        Args:
+          params: full param pytree (dp untouched, returned as-is).
+          opt_states: from `init_sparse_state`.
+          tap_grads: gradient w.r.t. the `make_taps` pytree.
+          residuals: TapResiduals from `apply(..., return_residuals=True)`.
+          opt: a SparseOptimizer (make_sparse_optimizer).
+
+        Returns (new_params, new_opt_states). The O(touched rows) analogue
+        of the reference backward + IndexedSlices apply
+        (embedding_lookup_kernels.cu:603-775): no [V, w] dense gradient, no
+        full-table optimizer pass.
+        """
+        groups, _ = self._exchange_groups_for_key(residuals.key)
+        n_buckets = len(self.plan.tp_buckets)
+        off_buckets = [b for b in range(n_buckets)
+                       if self._bucket_memory_kind(b)]
+        dev_buckets = [b for b in range(n_buckets) if b not in off_buckets]
+        if off_buckets and opt.kind not in sparse_update_ops.HOST_SPARSE_APPLY:
+            raise NotImplementedError(
+                f"sparse optimizer {opt.kind!r} has no host-memory apply "
+                "rule for offloaded buckets (additive rules only: "
+                f"{sorted(sparse_update_ops.HOST_SPARSE_APPLY)})")
+        tp_dev = [params["tp"][b] for b in dev_buckets]
+        tp_dev_s = [opt_states["tp"][b] for b in dev_buckets]
+
+        args = (tp_dev, params["row"], tp_dev_s,
+                opt_states["row"], tap_grads["tp"], tap_grads["row"],
+                residuals.tp_ids, residuals.tp_w, residuals.row_ids,
+                residuals.row_w)
+        if self.world_size > 1:
+            sspec = lambda tree: jax.tree.map(self._state_spec, tree)
+            pspec = lambda tree, s: jax.tree.map(lambda _: s, tree)
+            in_specs = (pspec(tp_dev, P(self.axis)),
+                        pspec(params["row"], P(self.axis)),
+                        sspec(tp_dev_s), sspec(opt_states["row"]),
+                        pspec(tap_grads["tp"], P(self.axis)),
+                        pspec(tap_grads["row"], P(self.axis)),
+                        pspec(residuals.tp_ids, P(self.axis)),
+                        pspec(residuals.tp_w, P(self.axis)),
+                        pspec(residuals.row_ids, P(self.axis)),
+                        pspec(residuals.row_w, P(self.axis)))
+            out_specs = (pspec(tp_dev, P(self.axis)),
+                         pspec(params["row"], P(self.axis)),
+                         sspec(tp_dev_s), sspec(opt_states["row"]))
+            new_tp_dev, new_row, new_tp_dev_s, new_row_s = jax.shard_map(
+                lambda *a: self._sparse_update_body(*a, groups, opt,
+                                                    dev_buckets),
+                mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False)(*args)
+        else:
+            new_tp_dev, new_row, new_tp_dev_s, new_row_s = (
+                self._sparse_update_body(*args, groups, opt, dev_buckets))
+
+        new_tp = list(params["tp"])
+        new_tp_s = list(opt_states["tp"])
+        for pos, b in enumerate(dev_buckets):
+            new_tp[b] = new_tp_dev[pos]
+            new_tp_s[b] = new_tp_dev_s[pos]
+        # offloaded buckets: dedup to (rep, sums) here (device-side, inside
+        # the caller's jit); the host-memory apply happens OUTSIDE the step
+        # jit (host_bucket_apply) — XLA only honors host placement of
+        # outputs at top level, and host params must stay read-only inside
+        # the SPMD program
+        pending = {b: self._host_bucket_pending(b, groups, tap_grads["tp"],
+                                                residuals)
+                   for b in off_buckets}
+        new_params = {"dp": params["dp"], "tp": new_tp, "row": new_row}
+        return new_params, {"tp": new_tp_s, "row": new_row_s}, pending
+
+    def _host_bucket_pending(self, b, groups, tp_g, residuals):
+        """Deduped (rep, sums) update rows for one offloaded bucket,
+        computed on device: [world, N] / [world, N, w] arrays sharded over
+        the mesh axis (vmap over the world axis keeps each shard's sort
+        local — no cross-device traffic)."""
+        bucket = self.plan.tp_buckets[b]
+        rows = max(bucket.rows_max, 1)
+        gs = [g for g, grp in enumerate(groups) if grp.bucket == b]
+        grad = concat_grads([
+            self._group_contrib(g, groups[g], residuals.tp_ids,
+                                residuals.tp_w, tp_g, stacked=True)
+            for g in gs])
+        return jax.vmap(
+            lambda i, c: sparse_update_ops.prepare_safe_grad(i, c, rows))(
+                grad.ids, grad.contribs)
+
+    def host_bucket_apply(self, b, table_h, state_h, rep, sums,
+                          opt: SparseOptimizer, lr_value=None):
+        """Apply deduped rows to an offloaded bucket's host-resident table.
+
+        Tries the native path first — a top-level jit whose outputs are
+        pinned host memory, with the row scatter in a compute_on host region
+        (zero full-table traffic). Where the backend cannot partition host
+        placements (XLA:CPU SPMD, 'Side-effect ops cannot be replicated'),
+        falls back to a device round-trip: pull the bucket shard to device,
+        update, place back — correct, but costs a full-bucket transfer per
+        step (acceptable for tests; TPU takes the native path).
+        """
+        apply_fn = sparse_update_ops.HOST_SPARSE_APPLY[opt.kind]
+        hp = dict(opt.hp)
+        kw = {"eps": hp["eps"]} if (opt.kind == "adagrad"
+                                    and "eps" in hp) else {}
+        if self.mesh is not None:
+            host_sh = NamedSharding(self.mesh, P(self.axis),
+                                    memory_kind="pinned_host")
+            dev_sh = NamedSharding(self.mesh, P(self.axis))
+        else:
+            dev0 = jax.devices()[0]
+            host_sh = jax.sharding.SingleDeviceSharding(
+                dev0, memory_kind="pinned_host")
+            dev_sh = jax.sharding.SingleDeviceSharding(dev0)
+        vapply = jax.vmap(
+            lambda t, s, r, sm, l: apply_fn(t, s, r, sm, l, **kw),
+            in_axes=(0, 0, 0, 0, None))
+        lr_in = opt.lr if lr_value is None else lr_value
+
+        key = ("host_apply", b, opt.kind, rep.shape, sums.shape,
+               lr_value is None)
+        mode_key = ("host_apply_mode", b, opt.kind)
+        fn = self._host_fn_cache.get(key)
+        if fn is None:
+            from jax.experimental import compute_on
+
+            def run_native(table_h, state_h, rep, sums, lr_a):
+                rep_h = jax.device_put(rep, host_sh)
+                sums_h = jax.device_put(sums, host_sh)
+                with compute_on.compute_on("device_host"):
+                    return vapply(table_h, state_h, rep_h, sums_h, lr_a)
+
+            out_sh = jax.tree.map(lambda _: host_sh, (table_h, state_h))
+            native = jax.jit(run_native, out_shardings=out_sh)
+            roundtrip_core = jax.jit(vapply)
+
+            def run_roundtrip(table_h, state_h, rep, sums, lr_a):
+                t_dev = jax.device_put(table_h, dev_sh)
+                s_dev = jax.tree.map(
+                    lambda x: jax.device_put(x, dev_sh), state_h)
+                new_t, new_s = roundtrip_core(t_dev, s_dev, rep, sums, lr_a)
+                return (jax.device_put(new_t, host_sh),
+                        jax.tree.map(lambda x: jax.device_put(x, host_sh),
+                                     new_s))
+
+            mode = self._host_fn_cache.get(mode_key)
+            if mode == "roundtrip":
+                fn = run_roundtrip
+            elif mode == "native":
+                fn = native
+            else:
+                def probe(table_h, state_h, rep, sums, lr_a):
+                    try:
+                        out = native(table_h, state_h, rep, sums, lr_a)
+                        self._host_fn_cache[mode_key] = "native"
+                        self._host_fn_cache[key] = native
+                        return out
+                    except Exception:  # noqa: BLE001 - backend limitation
+                        self._host_fn_cache[mode_key] = "roundtrip"
+                        self._host_fn_cache[key] = run_roundtrip
+                        return run_roundtrip(table_h, state_h, rep, sums,
+                                             lr_a)
+                fn = probe
+            self._host_fn_cache.setdefault(key, fn)
+        return fn(table_h, state_h, rep, sums, jnp.asarray(lr_in,
+                                                           jnp.float32))
 
     @staticmethod
     def _restore_shape(out, p: _PreparedInput, combiner, width):
@@ -1002,15 +1664,20 @@ class DistributedEmbedding:
             new["dp"] = [jax.device_put(a, rep) for a in new["dp"]]
             for b in range(len(self.plan.tp_buckets)):
                 new["tp"].append(self._stack_sharded(
-                    lambda rank, b=b: tp_shard(rank, b)))
+                    lambda rank, b=b: tp_shard(rank, b),
+                    memory_kind=self._bucket_memory_kind(b)))
             for t_local, gtid in enumerate(strat.table_groups[2]):
                 new["row"].append(self._stack_sharded(
                     lambda rank, t=t_local, g=gtid: row_shard(rank, t, g)))
         else:
             for b in range(len(self.plan.tp_buckets)):
-                new["tp"].append(jnp.stack(
-                    [jnp.asarray(tp_shard(r, b))
-                     for r in range(self.world_size)]))
+                arr = jnp.stack([jnp.asarray(tp_shard(r, b))
+                                 for r in range(self.world_size)])
+                mk = self._bucket_memory_kind(b)
+                if mk:
+                    arr = jax.device_put(arr, jax.sharding.SingleDeviceSharding(
+                        jax.devices()[0], memory_kind=mk))
+                new["tp"].append(arr)
             for t_local, gtid in enumerate(strat.table_groups[2]):
                 new["row"].append(jnp.stack(
                     [jnp.asarray(row_shard(r, t_local, gtid))

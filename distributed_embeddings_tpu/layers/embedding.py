@@ -198,7 +198,22 @@ class IntegerLookup:
 
     def __call__(self, inputs):
         arr = np.asarray(inputs, dtype=np.int64)
-        out = self._backend.lookup_or_insert(arr.reshape(-1))
+        flat = arr.reshape(-1)
+        # per-batch unique before touching the hash (the reference's CPU
+        # backend does exactly this, embedding.py:246-252): power-law id
+        # streams are duplicate-heavy, so hashing |unique| << N keys wins.
+        # np.unique sorts; reorder by first appearance so insertion ids (and
+        # get_vocabulary order) match the sequential contract.
+        uniq, first_idx, inv = np.unique(flat, return_index=True,
+                                         return_inverse=True)
+        if len(uniq) < len(flat):
+            order = np.argsort(first_idx, kind="stable")
+            out_u = self._backend.lookup_or_insert(uniq[order])
+            rank = np.empty_like(order)
+            rank[order] = np.arange(len(order))
+            out = out_u[rank][inv]
+        else:
+            out = self._backend.lookup_or_insert(flat)
         res = out.reshape(arr.shape)
         if isinstance(inputs, jax.Array):
             return jnp.asarray(res)

@@ -137,29 +137,43 @@ class DLRM:
         }
 
     def apply(self, params: dict, numerical: jax.Array,
-              categorical: Sequence[jax.Array]) -> jax.Array:
+              categorical: Sequence[jax.Array], taps=None,
+              return_residuals: bool = False):
         """Forward: [B, num_numerical] + categorical ids -> [B, 1] logit.
 
         With dp_input=True `categorical` is one global-batch id array per
         feature; with dp_input=False it is the nested per-rank form expected
         by DistributedEmbedding.apply_mp (reference dp_input semantics,
-        dist_model_parallel.py:729-731).
+        dist_model_parallel.py:729-731). taps/return_residuals: sparse
+        training hooks (see DistributedEmbedding.apply).
         """
         x = numerical.astype(self.compute_dtype)
         bottom = _mlp_apply(params["bottom_mlp"], x, final_activation=True)
-        emb_outs = self.embedding(params["embedding"], list(categorical))
+        res = None
+        if taps is not None or return_residuals:
+            emb_outs, res = self.embedding.apply(
+                params["embedding"], list(categorical), taps=taps,
+                return_residuals=True)
+        else:
+            emb_outs = self.embedding(params["embedding"], list(categorical))
         emb_outs = [e.astype(self.compute_dtype) for e in emb_outs]
         interact = dot_interact(emb_outs, bottom).astype(self.compute_dtype)
-        return _mlp_apply(params["top_mlp"], interact)
+        out = _mlp_apply(params["top_mlp"], interact)
+        return (out, res) if return_residuals else out
 
-    def loss_fn(self, params, numerical, categorical, labels):
-        logits = self.apply(params, numerical, categorical)[:, 0]
+    def loss_fn(self, params, numerical, categorical, labels, taps=None,
+                return_residuals: bool = False):
+        out = self.apply(params, numerical, categorical, taps=taps,
+                         return_residuals=return_residuals)
+        logits, res = out if return_residuals else (out, None)
+        logits = logits[:, 0]
         labels = labels.reshape(-1).astype(jnp.float32)
         logits = logits.astype(jnp.float32)
         # sigmoid binary cross-entropy, mean over the global batch
-        return jnp.mean(
+        loss = jnp.mean(
             jnp.maximum(logits, 0) - logits * labels
             + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        return (loss, res) if return_residuals else loss
 
     def make_train_step(self, optimizer):
         """Build a jittable train step: (opt_state, params, batch) -> updated."""

@@ -254,11 +254,18 @@ class SyntheticModel:
             emb = [l.init(k) for l, k in zip(self.embedding_layers, keys)]
         return {"embedding": emb, "mlp": _mlp_init(km, self.mlp_sizes, self.mlp_in)}
 
-    def apply(self, params, numerical, cat_features):
+    def apply(self, params, numerical, cat_features, taps=None,
+              return_residuals: bool = False):
+        res = None
         if self.distributed:
             # __call__ dispatches on dp_input: flat per-feature inputs for
             # the dp path, nested per-rank lists for the mp path
-            embs = self.embedding(params["embedding"], list(cat_features))
+            if taps is not None or return_residuals:
+                embs, res = self.embedding.apply(
+                    params["embedding"], list(cat_features), taps=taps,
+                    return_residuals=True)
+            else:
+                embs = self.embedding(params["embedding"], list(cat_features))
         else:
             embs = [self.embedding_layers[t](params["embedding"][t], ids)
                     for t, ids in zip(self.table_map, cat_features)]
@@ -267,11 +274,17 @@ class SyntheticModel:
         if self.interact_stride is not None:
             x = _avg_pool_1d(x, self.interact_stride)
         x = jnp.concatenate([x, numerical.astype(self.compute_dtype)], axis=1)
-        return _mlp_apply(params["mlp"], x)
+        out = _mlp_apply(params["mlp"], x)
+        return (out, res) if return_residuals else out
 
-    def loss_fn(self, params, numerical, cat_features, labels):
-        logits = self.apply(params, numerical, cat_features)[:, 0]
+    def loss_fn(self, params, numerical, cat_features, labels, taps=None,
+                return_residuals: bool = False):
+        out = self.apply(params, numerical, cat_features, taps=taps,
+                         return_residuals=return_residuals)
+        logits, res = out if return_residuals else (out, None)
+        logits = logits[:, 0]
         labels = labels.reshape(-1).astype(jnp.float32)
         logits = logits.astype(jnp.float32)
-        return jnp.mean(jnp.maximum(logits, 0) - logits * labels
+        loss = jnp.mean(jnp.maximum(logits, 0) - logits * labels
                         + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        return (loss, res) if return_residuals else loss

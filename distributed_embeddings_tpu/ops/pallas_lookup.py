@@ -69,8 +69,13 @@ def _onehot_kernel(ids_ref, w_ref, table_ref, out_ref, *, tile_v: int):
     a = jnp.zeros((tb, tile_v), jnp.float32)
     for k in range(ids.shape[1]):                  # K is small and static
         a = a + jnp.where(v_iota == ids[:, k:k + 1], w[:, k:k + 1], 0.0)
-    part = jnp.dot(a, table_ref[:].astype(jnp.float32),
-                   preferred_element_type=jnp.float32)
+    # HIGHEST: the MXU's default bf16 passes lose ~2^-8 relative accuracy
+    # (observed 2e-3 vs the f32 XLA path on hardware); the 3-pass f32
+    # emulation keeps the kernel bit-comparable to gather+reduce
+    part = jax.lax.dot_general(
+        a, table_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
 
     @pl.when(j == 0)
     def _():
@@ -86,7 +91,9 @@ def _onehot_lookup(table: jax.Array, ids: jax.Array, weights: jax.Array,
                    interpret: Optional[bool] = None) -> jax.Array:
     batch, k = ids.shape
     vocab, width = table.shape
-    tile_b = min(tile_b, max(8, batch))
+    # sublane-align the batch tile (Mosaic wants multiples of 8; odd sizes
+    # compiled but returned wrong results on hardware)
+    tile_b = min(tile_b, max(8, -(-batch // 8) * 8))
     pad_b = -batch % tile_b
     if pad_b:
         ids = jnp.pad(ids, ((0, pad_b), (0, 0)))
@@ -116,44 +123,74 @@ def _onehot_lookup(table: jax.Array, ids: jax.Array, weights: jax.Array,
 
 
 # --------------------------------------------------------------------------
-# large-vocab kernel: scalar-prefetched ids + double-buffered row DMA
+# large-vocab kernel: scalar-prefetched ids + deep-pipelined row DMA
 # --------------------------------------------------------------------------
+# Row gathers from HBM are latency/descriptor-rate bound on TPU, so the
+# kernel's job is to keep MANY row DMAs in flight: hotness is processed in
+# chunks of `hc` slots x `tile_b` rows (tile_b*hc concurrent copies),
+# double-buffered so chunk c+1's copies are in flight while chunk c combines.
+# DMA issue loops are lax.fori_loop, not Python-unrolled — the round-1 kernel
+# unrolled 2*tile_b*hot copy ops and crashed the compiler at hotness 200.
 def _dma_gather_kernel(ids_ref, w_ref, table_ref, out_ref, rows_ref, sems,
-                       *, tile_b: int, hot: int):
+                       *, tile_b: int, hot: int, hc: int):
     i = pl.program_id(0)
     base = i * tile_b * hot                        # ids are [B*K] row-major
+    nchunks = hot // hc
 
-    def row_copy(k, slot, t):
-        row = ids_ref[base + t * hot + k]
+    def dma(c, slot, j):
+        # j enumerates (t, kk) in the chunk: t = j // hc, kk = j % hc
+        t, kk = j // hc, j % hc
+        row = ids_ref[base + t * hot + c * hc + kk]
         return pltpu.make_async_copy(
-            table_ref.at[row], rows_ref.at[slot, t], sems.at[slot, t])
+            table_ref.at[row], rows_ref.at[slot, t, kk], sems.at[slot, j])
 
-    def start_k(k, slot):
-        for t in range(tile_b):
-            row_copy(k, slot, t).start()
+    def start_chunk(c, slot):
+        jax.lax.fori_loop(
+            0, tile_b * hc,
+            lambda j, _: (dma(c, slot, j).start(), 0)[1], 0)
 
-    def wait_k(k, slot):
-        for t in range(tile_b):
-            row_copy(k, slot, t).wait()
+    def wait_chunk(c, slot):
+        jax.lax.fori_loop(
+            0, tile_b * hc,
+            lambda j, _: (dma(c, slot, j).wait(), 0)[1], 0)
 
-    start_k(0, 0)
-    for k in range(hot):
-        slot = k % 2
-        if k + 1 < hot:
-            start_k(k + 1, (k + 1) % 2)
-        wait_k(k, slot)
-        contrib = rows_ref[slot].astype(jnp.float32) * w_ref[:, k:k + 1]
-        if k == 0:
-            out_ref[:] = contrib
-        else:
-            out_ref[:] = out_ref[:] + contrib
+    start_chunk(0, 0)
+    out_ref[:] = jnp.zeros_like(out_ref)
+
+    def body(c, _):
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < nchunks)
+        def _():
+            start_chunk(c + 1, jax.lax.rem(c + 1, 2))
+
+        wait_chunk(c, slot)
+        w_chunk = w_ref[:, pl.ds(c * hc, hc)]      # [tile_b, hc]
+        rows = rows_ref[slot].astype(jnp.float32)  # [tile_b, hc, width]
+        out_ref[:] = out_ref[:] + jnp.sum(rows * w_chunk[..., None], axis=1)
+        return 0
+
+    jax.lax.fori_loop(0, nchunks, body, 0)
+
+
+# target number of row copies in flight per buffer; bounds VMEM while hiding
+# HBM latency (in-flight bytes = 2 * DMA_DEPTH * width * 4)
+_DMA_DEPTH = 256
 
 
 def _dma_gather_lookup(table: jax.Array, ids: jax.Array, weights: jax.Array,
-                       tile_b: int = 8,
                        interpret: Optional[bool] = None) -> jax.Array:
     batch, hot = ids.shape
     _, width = table.shape
+    # batch tile: sublane-aligned, sized so tile_b * hc ~ _DMA_DEPTH
+    tile_b = max(8, min(256, -(-batch // 8) * 8))
+    hc = max(1, min(hot, _DMA_DEPTH // tile_b))
+    pad_k = -hot % hc
+    if pad_k:
+        # zero-weight padded hotness slots (id 0 is a safe in-bounds row)
+        ids = jnp.pad(ids, ((0, 0), (0, pad_k)))
+        weights = jnp.pad(weights, ((0, 0), (0, pad_k)))
+        hot += pad_k
     pad_b = -batch % tile_b
     if pad_b:
         ids = jnp.pad(ids, ((0, pad_b), (0, 0)))
@@ -170,12 +207,12 @@ def _dma_gather_lookup(table: jax.Array, ids: jax.Array, weights: jax.Array,
         out_specs=pl.BlockSpec((tile_b, width), lambda i, ids_ref: (i, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, tile_b, width), table.dtype),
-            pltpu.SemaphoreType.DMA((2, tile_b)),
+            pltpu.VMEM((2, tile_b, hc, width), table.dtype),
+            pltpu.SemaphoreType.DMA((2, tile_b * hc)),
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_dma_gather_kernel, tile_b=tile_b, hot=hot),
+        functools.partial(_dma_gather_kernel, tile_b=tile_b, hot=hot, hc=hc),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((batch + pad_b, width), jnp.float32),
         interpret=_interpret_default(interpret),

@@ -44,6 +44,9 @@ def parse_args(argv=None):
                    help="--no-dp_input benchmarks the model-parallel input "
                         "path (feature-sharded data, no id exchange)")
     p.add_argument("--amp", action="store_true")
+    p.add_argument("--dense_grads", action="store_true",
+                   help="use dense table gradients + optax instead of the "
+                        "default sparse row-wise update path")
     p.add_argument("--devices", type=int, default=0)
     p.add_argument("--force_cpu", action="store_true")
     p.add_argument("--table_scale", type=float, default=1.0,
@@ -104,10 +107,19 @@ def main(argv=None):
         return [[cats[strat.input_groups[1][pos]] for pos in rank_ids]
                 for rank_ids in strat.input_ids_list]
 
-    opt = {"sgd": optax.sgd, "adagrad": optax.adagrad,
-           "adam": optax.adam}[args.optimizer](args.lr)
-    opt_state = opt.init(params)
-    step_fn = make_train_step(model.loss_fn, opt, donate=False)
+    use_sparse = args.dp_input and not args.dense_grads
+    if use_sparse:
+        # production path: row-wise sparse embedding updates (no dense
+        # [V, w] grads, no full-table optimizer pass)
+        from distributed_embeddings_tpu.training import make_sparse_train_step
+        init_fn, step_fn = make_sparse_train_step(
+            model, args.optimizer, lr=args.lr, donate=False)
+        opt_state = init_fn(params)
+    else:
+        opt = {"sgd": optax.sgd, "adagrad": optax.adagrad,
+               "adam": optax.adam}[args.optimizer](args.lr)
+        opt_state = opt.init(params)
+        step_fn = make_train_step(model.loss_fn, opt, donate=False)
 
     gen = InputGenerator(cfg, args.batch_size, alpha=args.alpha,
                          num_batches=args.num_data_batches, seed=args.seed)

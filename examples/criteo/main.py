@@ -30,6 +30,8 @@ def parse_args(argv=None):
     p.add_argument("--csv", default=None,
                    help="Criteo Kaggle train.txt (tab-separated)")
     p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--bench_lookup", action="store_true",
+                   help="microbenchmark native vs numpy IntegerLookup")
     p.add_argument("--batch_size", type=int, default=4096)
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--max_tokens", type=int, default=100000,
@@ -122,6 +124,25 @@ def main(argv=None):
         loss, g = jax.value_and_grad(loss_fn)(p, numerical, idx, labels)
         updates, s = opt.update(g, s, p)
         return jax.tree.map(lambda a, b: a + b, p, updates), s, loss
+
+    if args.bench_lookup:
+        # IntegerLookup microbenchmark: native C++ hash vs numpy fallback,
+        # duplicate-heavy power-law keys (the realistic regime — the batch
+        # pre-unique makes per-unique hash cost the denominator)
+        rng = np.random.RandomState(0)
+        nb, bsz = 16, args.batch_size
+        keys = (rng.zipf(1.2, size=(nb, bsz)) * 2654435761 % (1 << 40)
+                ).astype(np.int64)
+        for use_native, label in ((True, "native"), (False, "numpy")):
+            lk = IntegerLookup(args.max_tokens, use_native=use_native)
+            lk(keys[0])  # warm
+            t0 = time.perf_counter()
+            for i in range(1, nb):
+                lk(keys[i])
+            dt = time.perf_counter() - t0
+            print(f"IntegerLookup[{label}]: "
+                  f"{(nb - 1) * bsz / dt:,.0f} keys/sec "
+                  f"(vocab {lk.size})", flush=True)
 
     if args.csv:
         batches = csv_batches(args.csv, args.batch_size, n_num, n_cat)

@@ -53,6 +53,9 @@ def parse_args(argv=None):
     p.add_argument("--warmup_steps", type=int, default=8000)
     p.add_argument("--decay_start_step", type=int, default=48000)
     p.add_argument("--decay_steps", type=int, default=24000)
+    p.add_argument("--dense_grads", action="store_true",
+                   help="dense table grads + optax instead of the default "
+                        "sparse row-wise update path")
     p.add_argument("--amp", action="store_true",
                    help="bfloat16 compute (reference AMP analogue)")
     p.add_argument("--dist_strategy", default="memory_balanced",
@@ -124,8 +127,6 @@ def main(argv=None):
     params = model.init(jax.random.PRNGKey(args.seed))
     schedule = make_lr_schedule(args.lr, args.warmup_steps,
                                 args.decay_start_step, args.decay_steps)
-    opt = optax.sgd(schedule)
-    opt_state = opt.init(params)
 
     if args.data_path:
         train_data = RawBinaryDataset(
@@ -148,10 +149,20 @@ def main(argv=None):
         train_data = batches
         steps = args.steps or 512
 
-    def loss_fn(p, numerical, cats, labels):
-        return model.loss_fn(p, numerical, cats, labels)
+    if args.dense_grads:
+        opt = optax.sgd(schedule)
+        opt_state = opt.init(params)
 
-    step_fn = make_train_step(loss_fn, opt, donate=False)
+        def loss_fn(p, numerical, cats, labels):
+            return model.loss_fn(p, numerical, cats, labels)
+
+        step_fn = make_train_step(loss_fn, opt, donate=False)
+    else:
+        # production path: row-wise sparse embedding updates
+        from distributed_embeddings_tpu.training import make_sparse_train_step
+        init_fn, step_fn = make_sparse_train_step(model, "sgd", lr=schedule,
+                                                  donate=False)
+        opt_state = init_fn(params)
 
     def get_batch(i):
         numerical, cats, labels = train_data[i % len(train_data)]

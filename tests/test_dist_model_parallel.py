@@ -43,6 +43,27 @@ def ref_apply(weights, inputs, table_map, combiners):
     return outs
 
 
+def _sgd_respecting_placement(p, g):
+    """p - LR*g, keeping offloaded (pinned-host) params in their memory
+    space: the update runs in device space, the result is placed back."""
+    def dev_sharding(x):
+        import jax.sharding as shd
+        s = x.sharding
+        if isinstance(s, shd.NamedSharding):
+            return shd.NamedSharding(s.mesh, s.spec)
+        return shd.SingleDeviceSharding(list(x.devices())[0])
+
+    if getattr(getattr(p, "sharding", None), "memory_kind", None) == \
+            "pinned_host":
+        pd = jax.device_put(p, dev_sharding(p))
+        gd = g
+        if getattr(getattr(g, "sharding", None), "memory_kind", None) == \
+                "pinned_host":
+            gd = jax.device_put(g, dev_sharding(g))
+        return jax.device_put(pd - LR * gd, p.sharding)
+    return p - LR * g
+
+
 def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
                       seed=0, check_train=True, input_max_hotness=None,
                       rtol=1e-5, atol=1e-5, train_rtol=1e-4, train_atol=1e-5,
@@ -106,7 +127,7 @@ def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
         return sum(jnp.vdot(o, c) for o, c in zip(outs, cots))
 
     dist_grads = jax.grad(dist_loss)(params)
-    new_params = jax.tree.map(lambda p, g: p - LR * g, params, dist_grads)
+    new_params = jax.tree.map(_sgd_respecting_placement, params, dist_grads)
 
     ref_grads = jax.grad(ref_loss)(ref_w)
     new_ref = [w - LR * g for w, g in zip(ref_w, ref_grads)]
@@ -315,7 +336,7 @@ def check_mp_equivalence(specs, world=8, input_table_map=None, seed=0,
         return sum(jnp.vdot(o, c) for o, c in zip(outs, cots))
 
     dist_grads = jax.grad(dist_loss)(params)
-    new_params = jax.tree.map(lambda p, g: p - LR * g, params, dist_grads)
+    new_params = jax.tree.map(_sgd_respecting_placement, params, dist_grads)
     ref_grads = jax.grad(ref_loss)(ref_w)
     new_ref = [w - LR * g for w, g in zip(ref_w, ref_grads)]
     got = dist.get_weights(new_params)

@@ -1,0 +1,153 @@
+"""Physical host offload: placement, forward equivalence, sparse training.
+
+Reference behavior: tables past the gpu_embedding_size budget are built under
+/CPU:0 and looked up there (reference dist_model_parallel.py:449-476,
+:829-831, :1186-1189). Here: offloaded buckets live in pinned_host memory
+(assert via sharding.memory_kind — the device-memory-exclusion proof), their
+lookups run in a compute_on host region, and sparse training updates them in
+host memory.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_embeddings_tpu.layers.embedding import Embedding
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    DistributedEmbedding)
+from distributed_embeddings_tpu.parallel.mesh import create_mesh
+from distributed_embeddings_tpu.training import make_sparse_train_step
+
+from test_sparse_train import TinyModel, BATCH
+
+# 8 one-hot tables; the two 5000-row ones blow a 20k-element device budget
+SPECS = [(5000, 16, "sum"), (40, 16, "sum"), (5000, 16, "sum"),
+         (64, 16, "sum"), (128, 16, "sum"), (96, 16, "sum"),
+         (80, 16, "sum"), (72, 16, "sum")]
+# total tp elements ~ 166k: a 40k budget forces the two 5000-row tables out
+BUDGET = 2500 * 16
+
+
+def _build(mesh, offload: bool, **kw):
+    return DistributedEmbedding(
+        [Embedding(v, w, combiner=c) for v, w, c in SPECS], mesh=mesh,
+        gpu_embedding_size=(BUDGET if offload else None), **kw)
+
+
+def test_offload_placement_and_forward():
+    rng = np.random.RandomState(0)
+    mesh = create_mesh(jax.devices()[:8])
+    dist_off = _build(mesh, True)
+    dist_dev = _build(mesh, False)
+    assert dist_off._offload_enabled
+    offloaded = [b for b, bk in enumerate(dist_off.plan.tp_buckets)
+                 if bk.offload]
+    assert offloaded, "budget should force at least one offloaded bucket"
+
+    weights = [rng.randn(v, w).astype(np.float32) * 0.1 for v, w, _ in SPECS]
+    p_off = dist_off.set_weights(weights)
+    p_dev = dist_dev.set_weights(weights)
+
+    # device-memory exclusion: offloaded buckets are pinned-host arrays
+    for b, bk in enumerate(dist_off.plan.tp_buckets):
+        kind = p_off["tp"][b].sharding.memory_kind
+        assert kind == ("pinned_host" if bk.offload else "device"), \
+            f"bucket {b}: {kind}"
+
+    inputs = [jnp.asarray(rng.randint(0, v, size=(BATCH, 2)))
+              for v, _, _ in SPECS]
+    out_off = dist_off.apply(p_off, inputs)
+    out_dev = dist_dev.apply(p_dev, inputs)
+    for i, (a, b) in enumerate(zip(out_dev, out_off)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5,
+                                   atol=1e-5, err_msg=f"output {i}")
+    # weights round-trip through the host placement
+    got = dist_off.get_weights(p_off)
+    for t, (a, b) in enumerate(zip(weights, got)):
+        np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+
+
+def test_offload_weighted_mean_forward():
+    """Regression: mean-combiner offloaded lookups with explicit weights must
+    not get the uniform 1/k scale on top of the normalized weights."""
+    rng = np.random.RandomState(5)
+    mesh = create_mesh(jax.devices()[:8])
+    specs = [(5000, 16, "mean"), (40, 16, "mean"), (5000, 16, "sum"),
+             (64, 16, "mean"), (128, 16, "sum"), (96, 16, "mean"),
+             (80, 16, "sum"), (72, 16, "mean")]
+
+    def build(offload):
+        return DistributedEmbedding(
+            [Embedding(v, w, combiner=c) for v, w, c in specs], mesh=mesh,
+            gpu_embedding_size=(BUDGET if offload else None))
+
+    dist_off, dist_dev = build(True), build(False)
+    assert any(b.offload for b in dist_off.plan.tp_buckets)
+    weights = [rng.randn(v, w).astype(np.float32) * 0.1 for v, w, _ in specs]
+    p_off = dist_off.set_weights(weights)
+    p_dev = dist_dev.set_weights(weights)
+    inputs = [(jnp.asarray(rng.randint(0, v, size=(BATCH, 3))),
+               jnp.asarray(np.abs(rng.rand(BATCH, 3)).astype(np.float32)))
+              for v, _, _ in specs]
+    out_off = dist_off.apply(p_off, inputs)
+    out_dev = dist_dev.apply(p_dev, inputs)
+    for i, (a, b) in enumerate(zip(out_dev, out_off)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5,
+                                   atol=1e-5, err_msg=f"output {i}")
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adagrad"])
+def test_offload_sparse_train_matches_device(optimizer):
+    """Offloading must not change training numerics: sparse train steps on an
+    offloaded model == the same steps on the all-device model."""
+    rng = np.random.RandomState(1)
+    mesh = create_mesh(jax.devices()[:8])
+    weights = [rng.randn(v, w).astype(np.float32) * 0.1 for v, w, _ in SPECS]
+
+    results = []
+    for offload in (False, True):
+        model = TinyModel(SPECS, mesh,
+                          gpu_embedding_size=(BUDGET if offload else None))
+        if offload:
+            assert any(b.offload for b in model.embedding.plan.tp_buckets)
+        init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.05,
+                                                  strategy="sort")
+        params = {"embedding": model.embedding.set_weights(weights),
+                  "head": {"w": jnp.asarray(
+                      np.random.RandomState(7).randn(
+                          sum(w for _, w, _ in SPECS), 1).astype(np.float32))}}
+        opt_state = init_fn(params)
+        rng2 = np.random.RandomState(3)
+        losses = []
+        for _ in range(3):
+            cats = [jnp.asarray(rng2.randint(0, v, size=(BATCH, 2)))
+                    for v, _, _ in SPECS]
+            labels = jnp.asarray(rng2.randn(BATCH).astype(np.float32))
+            params, opt_state, loss = step_fn(params, opt_state,
+                                              jnp.zeros((BATCH, 1)), cats,
+                                              labels)
+            losses.append(float(loss))
+        results.append((losses, model.embedding.get_weights(
+            params["embedding"])))
+
+    (l_dev, w_dev), (l_off, w_off) = results
+    np.testing.assert_allclose(l_off, l_dev, rtol=1e-5, atol=1e-6)
+    for t, (a, b) in enumerate(zip(w_dev, w_off)):
+        np.testing.assert_allclose(b, a, rtol=2e-5, atol=2e-5,
+                                   err_msg=f"table {t}")
+
+
+def test_offload_adam_unsupported():
+    mesh = create_mesh(jax.devices()[:8])
+    model = TinyModel(SPECS, mesh, gpu_embedding_size=BUDGET)
+    init_fn, step_fn = make_sparse_train_step(model, "adam", lr=0.01)
+    params = {"embedding": model.embedding.init(jax.random.PRNGKey(0)),
+              "head": {"w": jnp.zeros((sum(w for _, w, _ in SPECS), 1))}}
+    opt_state = init_fn(params)
+    rng = np.random.RandomState(0)
+    cats = [jnp.asarray(rng.randint(0, v, size=(BATCH, 2)))
+            for v, _, _ in SPECS]
+    with pytest.raises(NotImplementedError, match="host-memory apply"):
+        step_fn(params, opt_state, jnp.zeros((BATCH, 1)), cats,
+                jnp.zeros(BATCH))

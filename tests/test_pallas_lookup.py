@@ -52,7 +52,7 @@ def test_onehot_kernel_vs_ref(batch, hot, vocab, width):
 ])
 def test_dma_gather_kernel_vs_ref(batch, hot, vocab, width):
     table, ids, weights = make_case(batch, hot, vocab, width, seed=1)
-    got = _dma_gather_lookup(table, ids, weights, tile_b=8, interpret=True)
+    got = _dma_gather_lookup(table, ids, weights, interpret=True)
     want = ref_weighted(table, ids, weights)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 

@@ -1,0 +1,148 @@
+"""Sparse row-wise optimizer updates vs dense reference (optax formulas).
+
+The contract (reference: IndexedSlices consumption of the grad kernel's
+(unique_ids, unique_grads) output, embedding_lookup_ops.py:105-122): a sparse
+update with per-contribution (ids, rows) must equal the dense update with the
+scatter-added dense gradient, on every strategy.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from distributed_embeddings_tpu.ops import sparse_update as su
+
+
+def make_case(rng, n=257, v=50, w=8, oob=False):
+    ids = rng.integers(0, v, size=(n,)).astype(np.int32)
+    contribs = rng.standard_normal((n, w)).astype(np.float32)
+    if oob:
+        # padded slots: id == v with zero rows must be dropped
+        ids[::7] = v
+        contribs[::7] = 0.0
+    dense = np.zeros((v, w), np.float32)
+    np.add.at(dense, ids[ids < v], contribs[ids < v])
+    return ids, contribs, dense
+
+
+def test_dedup_sum_exact():
+    rng = np.random.default_rng(0)
+    ids, contribs, dense = make_case(rng)
+    rep, sums = su.dedup_sum(jnp.asarray(ids), jnp.asarray(contribs),
+                             sentinel=50)
+    rep, sums = np.asarray(rep), np.asarray(sums)
+    got = np.zeros_like(dense)
+    for r, s in zip(rep, sums):
+        if r < 50:
+            got[r] += s
+    np.testing.assert_allclose(got, dense, rtol=1e-6, atol=1e-6)
+    # each id appears exactly once among rep
+    real = rep[rep < 50]
+    assert len(real) == len(set(real.tolist()))
+
+
+@pytest.mark.parametrize("strategy", ["sort", "dense"])
+@pytest.mark.parametrize("oob", [False, True])
+def test_sparse_adagrad_matches_optax(strategy, oob):
+    rng = np.random.default_rng(1)
+    ids, contribs, dense = make_case(rng, oob=oob)
+    table = rng.standard_normal((50, 8)).astype(np.float32)
+    lr, eps, acc0 = 0.05, 1e-7, 0.1
+
+    opt = optax.adagrad(lr, initial_accumulator_value=acc0, eps=eps)
+    state = opt.init(jnp.asarray(table))
+    upd, _ = opt.update(jnp.asarray(dense), state, jnp.asarray(table))
+    want = np.asarray(jnp.asarray(table) + upd)
+
+    t2, acc2 = su.sparse_adagrad(
+        jnp.asarray(table), jnp.full((50, 8), acc0, jnp.float32),
+        su.SparseRowGrad(jnp.asarray(ids), jnp.asarray(contribs)),
+        lr, eps=eps, strategy=strategy)
+    np.testing.assert_allclose(np.asarray(t2), want, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(acc2), acc0 + dense * dense,
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("oob", [False, True])
+def test_sparse_sgd_matches_dense(oob):
+    rng = np.random.default_rng(2)
+    ids, contribs, dense = make_case(rng, oob=oob)
+    table = rng.standard_normal((50, 8)).astype(np.float32)
+    got = su.sparse_sgd(jnp.asarray(table),
+                        su.SparseRowGrad(jnp.asarray(ids),
+                                         jnp.asarray(contribs)), 0.1)
+    np.testing.assert_allclose(np.asarray(got), table - 0.1 * dense,
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("strategy", ["sort", "dense"])
+def test_sparse_adam_touched_rows_match_optax(strategy):
+    """Lazy sparse Adam == dense Adam on rows where the dense grad is
+    nonzero, over multiple steps with every row touched."""
+    rng = np.random.default_rng(3)
+    v, w = 30, 4
+    table = rng.standard_normal((v, w)).astype(np.float32)
+    lr = 0.01
+    opt = optax.adam(lr)
+    dstate = opt.init(jnp.asarray(table))
+    dtable = jnp.asarray(table)
+
+    sopt = su.make_sparse_optimizer("adam", lr, strategy=strategy)
+    stable = jnp.asarray(table)
+    sstate = sopt.init(stable)
+
+    for step in range(3):
+        # every row touched (ids = permutation + extras) so lazy == dense
+        ids = np.concatenate([rng.permutation(v),
+                              rng.integers(0, v, 17)]).astype(np.int32)
+        contribs = rng.standard_normal((len(ids), w)).astype(np.float32)
+        dense = np.zeros((v, w), np.float32)
+        np.add.at(dense, ids, contribs)
+
+        upd, dstate = opt.update(jnp.asarray(dense), dstate, dtable)
+        dtable = dtable + upd
+        stable, sstate = sopt.update(
+            stable, sstate, su.SparseRowGrad(jnp.asarray(ids),
+                                             jnp.asarray(contribs)))
+        np.testing.assert_allclose(np.asarray(stable), np.asarray(dtable),
+                                   rtol=3e-5, atol=3e-5,
+                                   err_msg=f"step {step}")
+
+
+def test_sparse_adagrad_untouched_rows_unchanged():
+    rng = np.random.default_rng(4)
+    table = rng.standard_normal((50, 8)).astype(np.float32)
+    ids = jnp.asarray([3, 3, 7], jnp.int32)
+    contribs = jnp.asarray(rng.standard_normal((3, 8)), jnp.float32)
+    for strategy in ("sort", "dense"):
+        t2, _ = su.sparse_adagrad(
+            jnp.asarray(table), jnp.full((50, 8), 0.1, jnp.float32),
+            su.SparseRowGrad(ids, contribs), 0.1, strategy=strategy)
+        t2 = np.asarray(t2)
+        mask = np.ones(50, bool)
+        mask[[3, 7]] = False
+        np.testing.assert_array_equal(t2[mask], table[mask])
+        assert not np.allclose(t2[3], table[3])
+
+
+def test_concat_grads_and_jit():
+    rng = np.random.default_rng(5)
+    g1 = su.SparseRowGrad(jnp.asarray(rng.integers(0, 20, 10), jnp.int32),
+                          jnp.asarray(rng.standard_normal((10, 4)),
+                                      jnp.float32))
+    g2 = su.SparseRowGrad(jnp.asarray(rng.integers(0, 20, 6), jnp.int32),
+                          jnp.asarray(rng.standard_normal((6, 4)),
+                                      jnp.float32))
+    g = su.concat_grads([g1, g2])
+    assert g.ids.shape == (16,) and g.contribs.shape == (16, 4)
+
+    table = jnp.asarray(rng.standard_normal((20, 4)), jnp.float32)
+    acc = jnp.full((20, 4), 0.1, jnp.float32)
+    f = jax.jit(lambda t, a, i, c: su.sparse_adagrad(
+        t, a, su.SparseRowGrad(i, c), 0.1, strategy="sort"))
+    t2, a2 = f(table, acc, g.ids, g.contribs)
+    t3, a3 = su.sparse_adagrad(table, acc, g, 0.1, strategy="dense")
+    np.testing.assert_allclose(np.asarray(t2), np.asarray(t3), rtol=2e-5,
+                               atol=2e-5)

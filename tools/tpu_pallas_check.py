@@ -1,0 +1,138 @@
+"""Hardware validation: run the Pallas lookup kernels COMPILED on a real TPU.
+
+Round-1 verdict: every Pallas test ran interpret=True on CPU; the compiled
+path had never executed. This script runs both kernels (one-hot MXU matmul
+and DMA-gather) with interpret=False on the attached chip, compares against
+the XLA-native reference, and times them vs the plain take+einsum path.
+
+Usage: python tools/tpu_pallas_check.py [--quick]
+Exit 0 = all cases pass; nonzero = mismatch or compile failure.
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from distributed_embeddings_tpu.ops import pallas_lookup  # noqa: E402
+
+
+def xla_ref(table, ids, weights, combiner):
+    ids = jnp.clip(ids, 0, table.shape[0] - 1)
+    if combiner == "mean":
+        denom = jnp.maximum(jnp.sum(weights, axis=1, keepdims=True), 1.0)
+        weights = weights / denom
+    embs = jnp.take(table, ids, axis=0).astype(jnp.float32)
+    return jnp.einsum("bk,bkw->bw", weights.astype(jnp.float32), embs)
+
+
+def make_case(rng, batch, vocab, width, hot):
+    table = rng.standard_normal((vocab, width), dtype=np.float32)
+    ids = rng.integers(0, vocab, size=(batch, hot)).astype(np.int32)
+    k_true = rng.integers(1, hot + 1, size=(batch,))
+    weights = (np.arange(hot)[None, :] < k_true[:, None]).astype(np.float32)
+    return jnp.asarray(table), jnp.asarray(ids), jnp.asarray(weights)
+
+
+def bench(fn, *args, iters=50):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+    on_tpu = dev.platform == "tpu"
+    if not on_tpu:
+        print("WARNING: not a TPU — compiled-path check is meaningless here")
+
+    rng = np.random.default_rng(0)
+    # (batch, vocab, width, hot, combiner) — covers both kernels, unaligned
+    # batches (ADVICE: tile_b sublane alignment), hotness 1..200
+    cases = [
+        (4096, 1000, 64, 8, "sum"),       # onehot kernel, unaligned width
+        (4096, 8192, 128, 26, "mean"),    # onehot kernel upper vocab bound
+        (100, 1000, 128, 5, "sum"),       # odd batch < 256
+        (65536, 100000, 128, 1, "sum"),   # dma kernel, hotness 1
+        (16384, 1000000, 128, 10, "sum"),  # dma kernel, 1M vocab
+        (8192, 100000, 256, 30, "mean"),  # dma kernel, wide rows
+    ]
+    if not args.quick:
+        cases += [
+            (4096, 1000000, 128, 200, "sum"),  # jumbo hotness (VERDICT weak#2)
+            (999, 50000, 128, 7, "sum"),       # unaligned batch, dma kernel
+        ]
+
+    failures = 0
+    for batch, vocab, width, hot, comb in cases:
+        tag = f"B{batch} V{vocab} W{width} K{hot} {comb}"
+        table, ids, weights = make_case(rng, batch, vocab, width, hot)
+        try:
+            t0 = time.perf_counter()
+            fused = jax.jit(
+                lambda t, i, w: pallas_lookup.fused_embedding_lookup(
+                    t, i, w, comb, interpret=False))
+            out = fused(table, ids, weights)
+            jax.block_until_ready(out)
+            compile_s = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001
+            print(f"FAIL {tag}: compile/run error: {str(e)[:400]}")
+            failures += 1
+            continue
+        ref = jax.jit(lambda t, i, w: xla_ref(t, i, w, comb))(
+            table, ids, weights)
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+        scale = float(jnp.max(jnp.abs(ref))) + 1e-6
+        ok = err / scale < 1e-5
+        t_pallas = bench(fused, table, ids, weights, iters=20)
+        t_xla = bench(jax.jit(lambda t, i, w: xla_ref(t, i, w, comb)),
+                      table, ids, weights, iters=20)
+        status = "ok  " if ok else "BAD "
+        if not ok:
+            failures += 1
+        print(f"{status}{tag}: relerr={err / scale:.2e} "
+              f"pallas={t_pallas:.3f}ms xla={t_xla:.3f}ms "
+              f"speedup={t_xla / t_pallas:.2f}x compile={compile_s:.1f}s",
+              flush=True)
+
+    # grad path (XLA scatter-add through custom_vjp) on one mid case
+    table, ids, weights = make_case(rng, 4096, 100000, 128, 10)
+
+    def loss(t):
+        return jnp.sum(pallas_lookup.fused_embedding_lookup(
+            t, ids, weights, "sum", interpret=False) ** 2)
+
+    def loss_ref(t):
+        return jnp.sum(xla_ref(t, ids, weights, "sum") ** 2)
+
+    try:
+        g = jax.jit(jax.grad(loss))(table)
+        gr = jax.jit(jax.grad(loss_ref))(table)
+        gerr = float(jnp.max(jnp.abs(g - gr))) / (
+            float(jnp.max(jnp.abs(gr))) + 1e-6)
+        print(f"grad relerr={gerr:.2e} {'ok' if gerr < 1e-5 else 'BAD'}")
+        if gerr >= 1e-5:
+            failures += 1
+    except Exception as e:  # noqa: BLE001
+        print(f"FAIL grad: {str(e)[:400]}")
+        failures += 1
+
+    print(f"{'PASS' if failures == 0 else 'FAIL'}: {failures} failures")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
