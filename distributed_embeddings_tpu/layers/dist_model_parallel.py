@@ -666,7 +666,11 @@ class DistributedEmbedding:
         bucket = self.plan.tp_buckets[grp.bucket]
         world = self.world_size
         k, wf = grp.k, bucket.width
-        key = (g, ids_g.shape, None if w_g is None else w_g.shape,
+        # bucket identity must key the cache: the same group index can map
+        # to a different bucket under another hotness signature, and the
+        # closure bakes in rows_max / combiner / scale
+        key = (g, grp.bucket, bucket.combiner, ids_g.shape,
+               None if w_g is None else w_g.shape,
                None if tap is None else tap.shape)
         fn = self._host_fn_cache.get(key)
         if fn is None:
@@ -1221,18 +1225,18 @@ class DistributedEmbedding:
         [world, B, f_max_g, w_out] array per exchange group and one
         [world, B, (k,) w] array per row-sliced input. Create inside the
         jitted train step — XLA folds the zero adds away in the forward while
-        autodiff still delivers their cotangents."""
-        if not self.dp_input:
-            raise NotImplementedError(
-                "make_taps currently supports dp_input=True; for mp-input "
-                "training, construct per-group taps matching apply_mp's "
-                "exchange groups directly")
-        prepped = self._prepare_inputs(inputs)
+        autodiff still delivers their cotangents. Accepts dp-form flat inputs
+        (dp_input=True) or the nested per-rank lists of apply_mp."""
         strat = self.strategy
-        batch = prepped[0].ids.shape[0]
         dtype = self.compute_dtype or jnp.float32
-        tp_prep = [prepped[i] for i in strat.input_groups[1]]
         taps = {"tp": [], "row": []}
+        if self.dp_input:
+            prepped = self._prepare_inputs(inputs)
+            batch = prepped[0].ids.shape[0]
+            tp_prep = [prepped[i] for i in strat.input_groups[1]]
+        else:
+            tp_prep, batch = self._mp_tp_preps(inputs)
+            prepped = None
         if tp_prep:
             groups, _ = self._exchange_groups(tp_prep)
             for grp in groups:
@@ -1249,6 +1253,41 @@ class DistributedEmbedding:
                      else (self.world_size, batch, p.k, rt.width))
             taps["row"].append(jnp.zeros(shape, dtype))
         return taps
+
+    def _mp_tp_preps(self, inputs):
+        """Representative _PreparedInputs per tp input from nested per-rank
+        apply_mp inputs (None ranks allowed when input_max_hotness covers
+        their inputs). Returns (tp_preps, global_batch)."""
+        strat = self.strategy
+        if self.world_size == 1 and (not inputs
+                                     or not isinstance(inputs[0], list)):
+            inputs = [list(inputs)]
+        input_prep: dict = {}
+        for r, ids_list in enumerate(strat.input_ids_list or []):
+            if r >= len(inputs) or inputs[r] is None:
+                continue
+            for x, inp_pos in zip(inputs[r], ids_list):
+                orig = strat.input_groups[1][inp_pos]
+                mh = (self.input_max_hotness[orig]
+                      if self.input_max_hotness is not None else None)
+                input_prep.setdefault(inp_pos, self._prepare_one(x, mh))
+        if not input_prep:
+            return [], 0
+        batch = next(iter(input_prep.values())).ids.shape[0]
+        for pos in range(len(strat.input_groups[1])):
+            if pos not in input_prep:
+                orig = strat.input_groups[1][pos]
+                if self.input_max_hotness is None or \
+                        self.input_max_hotness[orig] is None:
+                    raise ValueError(
+                        "make_taps with per-process mp inputs requires "
+                        "input_max_hotness for remote-rank features")
+                mh = self.input_max_hotness[orig]
+                input_prep[pos] = _PreparedInput(
+                    jnp.zeros((batch, mh), jnp.int32),
+                    jnp.zeros((batch, mh), jnp.float32), mh == 1, mh)
+        return ([input_prep[i] for i in range(len(strat.input_groups[1]))],
+                batch)
 
     def _state_spec(self, leaf):
         """Sharding spec rule for sparse-optimizer state leaves: table-shaped
@@ -1546,7 +1585,20 @@ class DistributedEmbedding:
                         self._host_fn_cache[mode_key] = "native"
                         self._host_fn_cache[key] = native
                         return out
-                    except Exception:  # noqa: BLE001 - backend limitation
+                    except jax.errors.JaxRuntimeError as e:
+                        # only the known backend gap (SPMD partitioners that
+                        # cannot place host-memory outputs) falls back; the
+                        # fallback pays a full-bucket device round-trip per
+                        # step, so say so once
+                        if "cannot be replicated" not in str(e):
+                            raise
+                        import warnings
+                        warnings.warn(
+                            "host-memory sparse apply unsupported on this "
+                            "backend (XLA: side-effect ops cannot be "
+                            "replicated); falling back to a device "
+                            "round-trip per step for offloaded bucket "
+                            f"{b}", RuntimeWarning, stacklevel=2)
                         self._host_fn_cache[mode_key] = "roundtrip"
                         self._host_fn_cache[key] = run_roundtrip
                         return run_roundtrip(table_h, state_h, rep, sums,
@@ -1567,10 +1619,13 @@ class DistributedEmbedding:
             out = out[:, 0, :]
         return out
 
-    def __call__(self, params, inputs):
+    def __call__(self, params, inputs, taps=None,
+                 return_residuals: bool = False):
         if self.dp_input:
-            return self.apply(params, inputs)
-        return self.apply_mp(params, inputs)
+            return self.apply(params, inputs, taps=taps,
+                              return_residuals=return_residuals)
+        return self.apply_mp(params, inputs, taps=taps,
+                             return_residuals=return_residuals)
 
     # --------------------------------------------------------- weights I/O
     def _shard_host(self, arr: jax.Array, rank: int) -> np.ndarray:

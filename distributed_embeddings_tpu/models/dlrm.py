@@ -151,7 +151,7 @@ class DLRM:
         bottom = _mlp_apply(params["bottom_mlp"], x, final_activation=True)
         res = None
         if taps is not None or return_residuals:
-            emb_outs, res = self.embedding.apply(
+            emb_outs, res = self.embedding(
                 params["embedding"], list(categorical), taps=taps,
                 return_residuals=True)
         else:

@@ -261,7 +261,7 @@ class SyntheticModel:
             # __call__ dispatches on dp_input: flat per-feature inputs for
             # the dp path, nested per-rank lists for the mp path
             if taps is not None or return_residuals:
-                embs, res = self.embedding.apply(
+                embs, res = self.embedding(
                     params["embedding"], list(cat_features), taps=taps,
                     return_residuals=True)
             else:

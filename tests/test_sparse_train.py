@@ -41,10 +41,10 @@ class TinyModel:
               return_residuals=False):
         res = None
         if taps is not None or return_residuals:
-            outs, res = self.embedding.apply(params["embedding"], list(cats),
-                                             taps=taps, return_residuals=True)
+            outs, res = self.embedding(params["embedding"], list(cats),
+                                       taps=taps, return_residuals=True)
         else:
-            outs = self.embedding.apply(params["embedding"], list(cats))
+            outs = self.embedding(params["embedding"], list(cats))
         outs = [o.reshape(o.shape[0], -1) for o in outs]
         x = jnp.concatenate(outs, axis=1).astype(jnp.float32)
         out = x @ params["head"]["w"]
@@ -193,6 +193,54 @@ def test_sparse_train_hybrid_dp_col_row():
              (60, 8, "sum"), (50, 8, "sum")]
     run_equivalence(specs, "adagrad", row_slice_threshold=2000,
                     data_parallel_threshold=64, rtol=2e-4, atol=2e-4)
+
+
+def test_sparse_train_mp_input_matches_dp():
+    """dp_input=False sparse training == dp_input=True sparse training on
+    the same global data (the mp loader just pre-shards by feature)."""
+    specs = [(40, 4, "sum"), (60, 8, "sum"), (30, 4, "sum"), (50, 8, "sum"),
+             (25, 4, "sum"), (70, 8, "sum"), (45, 4, "sum"), (35, 8, "sum")]
+    rng = np.random.RandomState(11)
+    mesh = create_mesh(jax.devices()[:8])
+    weights = [rng.randn(s[0], s[1]).astype(np.float32) * 0.1 for s in specs]
+    batches = []
+    for _ in range(3):
+        cats = [jnp.asarray(rng.randint(0, s[0], size=(BATCH, 2)))
+                for s in specs]
+        labels = jnp.asarray(rng.randn(BATCH).astype(np.float32))
+        batches.append((cats, labels))
+
+    results = []
+    for dp_input in (True, False):
+        model = TinyModel(specs, mesh, dp_input=dp_input)
+        strat = model.embedding.strategy
+
+        def to_inputs(cats, dp=dp_input):
+            if dp:
+                return cats
+            return [[cats[strat.input_groups[1][pos]] for pos in rank_ids]
+                    for rank_ids in strat.input_ids_list]
+
+        init_fn, step_fn = make_sparse_train_step(model, "adagrad", lr=0.05,
+                                                  strategy="sort")
+        params = {"embedding": model.embedding.set_weights(weights),
+                  "head": {"w": jnp.asarray(np.random.RandomState(7).randn(
+                      sum(s[1] for s in specs), 1).astype(np.float32))}}
+        state = init_fn(params)
+        losses = []
+        for cats, labels in batches:
+            params, state, loss = step_fn(params, state,
+                                          jnp.zeros((BATCH, 1)),
+                                          to_inputs(cats), labels)
+            losses.append(float(loss))
+        results.append((losses,
+                        model.embedding.get_weights(params["embedding"])))
+
+    (l_dp, w_dp), (l_mp, w_mp) = results
+    np.testing.assert_allclose(l_mp, l_dp, rtol=1e-5, atol=1e-6)
+    for t, (a, b) in enumerate(zip(w_dp, w_mp)):
+        np.testing.assert_allclose(b, a, rtol=2e-5, atol=2e-5,
+                                   err_msg=f"table {t}")
 
 
 def test_sparse_train_weighted_inputs():

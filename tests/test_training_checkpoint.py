@@ -49,6 +49,49 @@ def test_make_train_step_converges():
     assert losses[-1] < losses[0] * 0.2, losses[::20]
 
 
+def test_distributed_optimizer_postprocess():
+    """DistributedOptimizer's gradient-postprocess hook must actually shape
+    the update (reference: gradient postprocessing via the wrapped
+    optimizer)."""
+    dist = make_dist()
+    params = dist.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(2)
+    inputs = [jnp.asarray(rng.randint(0, v, (16,)).astype(np.int32))
+              for v, _ in SIZES]
+
+    def loss_fn(p, inputs):
+        outs = dist.apply(p, inputs)
+        return sum(jnp.sum(o) for o in outs)
+
+    calls = []
+
+    def zero_grads(grads):
+        calls.append(1)
+        return jax.tree.map(jnp.zeros_like, grads)
+
+    opt = training.DistributedOptimizer(optax.sgd(0.5),
+                                        postprocess=zero_grads)
+    opt_state = opt.init(params)
+    loss, grads = jax.value_and_grad(loss_fn)(params, inputs)
+    updates, opt_state = opt.update(grads, opt_state, params)
+    new_params = training.apply_updates(params, updates)
+    assert calls, "postprocess hook never invoked"
+    # zeroed grads -> parameters unchanged
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(new_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # scaling postprocess == scaling the lr for sgd
+    opt2 = training.DistributedOptimizer(
+        optax.sgd(0.5), postprocess=lambda g: jax.tree.map(lambda x: 2 * x, g))
+    st2 = opt2.init(params)
+    upd2, _ = opt2.update(grads, st2, params)
+    opt3 = training.DistributedOptimizer(optax.sgd(1.0))
+    st3 = opt3.init(params)
+    upd3, _ = opt3.update(grads, st3, params)
+    for a, b in zip(jax.tree.leaves(upd2), jax.tree.leaves(upd3)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
 def test_distributed_gradient_tape_shim():
     dist = make_dist()
     params = dist.init(jax.random.PRNGKey(0))
