@@ -499,15 +499,25 @@ class DistributedEmbedding:
                       combiner: Optional[str]) -> jax.Array:
         """Local fused-bucket lookup + combine: ids [B, f, k] -> [B, f, wf].
 
-        Multi-hot sum/mean groups route through the Pallas fused kernel on
-        TPU (the hot-loop equivalent of the reference's CUDA combiner,
-        cu:175-336); everything else is XLA gather + reduce, which XLA fuses.
-        (Offloaded buckets never reach here — their lookups run host-side in
-        `_host_group_exchange`.)
+        Path selection (overridable via DET_LOOKUP_PATH=auto|xla|pallas for
+        hardware A/B): combined sum/mean groups route through the Pallas
+        fused kernel on TPU (the hot-loop equivalent of the reference's CUDA
+        combiner, cu:175-336) — in 'auto' only for multi-hot (k > 1), under
+        'pallas' for one-hot gathers as well; 'xla' forces take + reduce,
+        which XLA fuses. (Offloaded buckets never reach here — their lookups
+        run host-side in `_host_group_exchange`.)
         """
+        import os
         b_sz, f, k = ids.shape
-        if (combiner in ("sum", "mean") and k > 1 and self.use_custom_kernel
-                and pallas_lookup.is_tpu_backend()):
+        path = os.environ.get("DET_LOOKUP_PATH", "auto")
+        if combiner is None and k == 1 and path == "pallas":
+            combiner = "sum"     # identical result at hotness 1
+        want_pallas = (self.use_custom_kernel
+                       and pallas_lookup.is_tpu_backend()
+                       and combiner in ("sum", "mean")
+                       and path != "xla"
+                       and (k > 1 or path == "pallas"))
+        if want_pallas:
             w = (weights if weights is not None
                  else jnp.ones((b_sz, f, k), jnp.float32))
             out = pallas_lookup.fused_embedding_lookup(

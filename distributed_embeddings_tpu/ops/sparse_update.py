@@ -39,7 +39,11 @@ from jax import lax
 
 # auto-strategy threshold: buckets up to this many elements aggregate through
 # a dense temp (64 MiB at f32 width 16); larger buckets use the sort path.
-DENSE_ELEMS_MAX = 16 * 1024 * 1024
+# Tunable per hardware via DET_SPARSE_DENSE_MAX.
+import os
+
+DENSE_ELEMS_MAX = int(os.environ.get("DET_SPARSE_DENSE_MAX",
+                                     16 * 1024 * 1024))
 
 
 def take_rows(table: jax.Array, ids: jax.Array) -> jax.Array:

@@ -27,3 +27,42 @@ def create_mesh(devices: Optional[Sequence] = None, axis_name: str = DEFAULT_AXI
 
 def default_mesh(axis_name: str = DEFAULT_AXIS) -> Mesh:
     return create_mesh(axis_name=axis_name)
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap: the TPU analogue of `hvd.init()` + MPI env
+    discovery (reference dist_model_parallel.py:759-762, dlrm/main.py:152).
+
+    On TPU pods with standard launchers (GKE, gcloud, xmanager) all
+    arguments auto-discover; pass them explicitly for bare-metal setups.
+    Safe to call more than once (subsequent calls no-op). After this,
+    `create_mesh()` spans every chip in the pod: jax device order puts
+    ICI-connected chips of a slice adjacent, so the 1-D axis's collectives
+    ride ICI within a slice and DCN across slices — the layout the
+    scaling-book recipe prescribes for a single combined dp/mp axis.
+    """
+    try:
+        from jax._src.distributed import global_state
+        already = global_state.client is not None
+    except Exception:  # noqa: BLE001 - internal layout differs by version
+        already = False
+    if jax.process_count() > 1 or already:
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (ValueError, RuntimeError) as e:
+        # single-process runs (no coordinator discoverable) stay local
+        if coordinator_address is not None:
+            raise
+        import logging
+        logging.getLogger(__name__).info(
+            "jax.distributed.initialize skipped (single process?): %s", e)

@@ -49,6 +49,70 @@ def test_make_train_step_converges():
     assert losses[-1] < losses[0] * 0.2, losses[::20]
 
 
+def test_sparse_train_checkpoint_resume(tmp_path):
+    """Save {params, sparse opt_state} mid-training, restore, continue:
+    must match the uninterrupted run exactly (the reference's resume
+    contract via get/set_weights, extended to optimizer state)."""
+    from distributed_embeddings_tpu.training import make_sparse_train_step
+    from distributed_embeddings_tpu.utils import checkpoint as ckpt_lib
+
+    class _M:
+        def __init__(self):
+            self.embedding = make_dist()
+
+        def loss_fn(self, params, numerical, cats, labels, taps=None,
+                    return_residuals=False):
+            if taps is not None or return_residuals:
+                outs, res = self.embedding.apply(
+                    params["embedding"], cats, taps=taps,
+                    return_residuals=True)
+            else:
+                outs, res = self.embedding.apply(params["embedding"],
+                                                 cats), None
+            x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs],
+                                axis=1)
+            loss = jnp.mean((jnp.sum(x, axis=1) - labels.reshape(-1)) ** 2)
+            return (loss, res) if return_residuals else loss
+
+    rng = np.random.RandomState(4)
+    batches = []
+    for _ in range(4):
+        batches.append((
+            [jnp.asarray(rng.randint(0, v, (16,)).astype(np.int32))
+             for v, _ in SIZES],
+            jnp.asarray(rng.randn(16).astype(np.float32))))
+
+    def train(n, params, state, step_fn):
+        for i in range(n):
+            cats, labels = batches[i % len(batches)]
+            params, state, loss = step_fn(params, state,
+                                          jnp.zeros((16, 1)), cats, labels)
+        return params, state
+
+    m1 = _M()
+    init_fn, step_fn = make_sparse_train_step(m1, "adagrad", lr=0.1)
+    params = {"embedding": m1.embedding.init(jax.random.PRNGKey(0))}
+    state = init_fn(params)
+    params, state = train(2, params, state, step_fn)
+    ckpt_lib.save_checkpoint(str(tmp_path / "ck"),
+                             {"params": params, "opt_state": state},
+                             force=True)
+    params_c, state_c = train(2, params, state, step_fn)
+
+    m2 = _M()
+    init2, step2 = make_sparse_train_step(m2, "adagrad", lr=0.1)
+    tmpl_params = {"embedding": m2.embedding.init(jax.random.PRNGKey(1))}
+    tmpl = {"params": tmpl_params, "opt_state": init2(tmpl_params)}
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path / "ck"), tmpl)
+    params_r, state_r = train(2, restored["params"], restored["opt_state"],
+                              step2)
+    got = m2.embedding.get_weights(params_r["embedding"])
+    want = m1.embedding.get_weights(params_c["embedding"])
+    for t, (a, b) in enumerate(zip(want, got)):
+        np.testing.assert_allclose(b, a, rtol=1e-6, atol=1e-6,
+                                   err_msg=f"table {t}")
+
+
 def test_distributed_optimizer_postprocess():
     """DistributedOptimizer's gradient-postprocess hook must actually shape
     the update (reference: gradient postprocessing via the wrapped
