@@ -40,6 +40,8 @@ __all__ = [
     "broadcast_variables",
     "make_train_step",
     "make_sparse_train_step",
+    "fit",
+    "evaluate",
 ]
 
 
@@ -295,3 +297,96 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
         return new_params, new_state, loss
 
     return init_fn, run
+
+
+def fit(model, params, data, steps: int, optimizer: str = "adagrad",
+        lr=0.01, sparse: bool = True, opt_state=None, dense_optimizer=None,
+        callbacks=(), eval_data=None, eval_every: int = 0,
+        eval_steps: int = 16, log_every: int = 100, log_fn=print):
+    """Minimal training-loop driver — the role the reference fills with
+    Keras `model.fit` + `DistributedOptimizer` + callbacks
+    (reference dist_model_parallel.py:1270-1326, synthetic main.py:104-114).
+
+    Args:
+      model: exposes `.embedding`, `loss_fn(params, numerical, cats, labels,
+        taps=..., return_residuals=...)` and (for eval) `apply`.
+      params: initial parameter pytree ({'embedding': ..., ...}).
+      data: iterable/callable yielding (numerical, cats, labels) batches
+        (jax or numpy arrays; a callable receives the step index).
+      steps: number of optimizer steps.
+      optimizer / lr / dense_optimizer: see make_sparse_train_step.
+      sparse: use the sparse tapped path (default) or dense optax grads.
+      callbacks: objects with optional `on_train_begin(params)` (e.g.
+        BroadcastGlobalVariablesCallback) and/or
+        `on_step(step, params, loss)` hooks.
+      eval_data / eval_every / eval_steps: run `evaluate` periodically.
+
+    Returns (params, opt_state, history) — history is a dict of lists
+    ('loss', optionally 'eval_auc').
+    """
+    if sparse:
+        init_fn, step_fn = make_sparse_train_step(
+            model, optimizer, lr=lr, dense_optimizer=dense_optimizer)
+        if opt_state is None:
+            opt_state = init_fn(params)
+    else:
+        import optax
+        opt = dense_optimizer or {
+            "sgd": lambda: optax.sgd(lr),
+            "adagrad": lambda: optax.adagrad(lr),
+            "adam": lambda: optax.adam(lr)}[optimizer]()
+
+        def loss_fn(p, numerical, cats, labels):
+            return model.loss_fn(p, numerical, cats, labels)
+        step_fn = make_train_step(loss_fn, opt, donate=False)
+        if opt_state is None:
+            opt_state = opt.init(params)
+
+    for cb in callbacks:
+        if hasattr(cb, "on_train_begin"):
+            params = cb.on_train_begin(params)
+
+    get_batch = data if callable(data) else None
+    it = iter(data) if get_batch is None else None
+    history = {"loss": []}
+    for step in range(steps):
+        batch = get_batch(step) if get_batch else next(it)
+        numerical, cats, labels = batch
+        params, opt_state, loss = step_fn(params, opt_state,
+                                          jnp.asarray(numerical),
+                                          [jnp.asarray(c) for c in cats],
+                                          jnp.asarray(labels))
+        loss = float(loss)          # block: keeps CPU collectives in lockstep
+        history["loss"].append(loss)
+        if log_every and step % log_every == 0:
+            log_fn(f"step {step}/{steps}: loss={loss:.5f}")
+        for cb in callbacks:
+            if hasattr(cb, "on_step"):
+                cb.on_step(step, params, loss)
+        if eval_data is not None and eval_every and \
+                (step + 1) % eval_every == 0:
+            auc = evaluate(model, params, eval_data, eval_steps)
+            history.setdefault("eval_auc", []).append(auc)
+            log_fn(f"step {step}: eval AUC={auc:.5f}")
+    return params, opt_state, history
+
+
+def evaluate(model, params, data, steps: int = 16) -> float:
+    """Streaming AUC over `steps` batches (the reference's eval loop,
+    examples/dlrm/main.py:223-243, without the hvd.allgather — outputs are
+    already global jax.Arrays under SPMD)."""
+    from distributed_embeddings_tpu.utils.metrics import StreamingAUC
+
+    auc = StreamingAUC()
+    state = auc.init()
+    get_batch = data if callable(data) else None
+    it = iter(data) if get_batch is None else None
+    fwd = jax.jit(lambda p, n, c: model.apply(p, n, c))
+    for step in range(steps):
+        numerical, cats, labels = (get_batch(step) if get_batch
+                                   else next(it))
+        logits = fwd(params, jnp.asarray(numerical),
+                     [jnp.asarray(c) for c in cats])
+        state = auc.update(state, jnp.asarray(labels).reshape(-1),
+                           logits.reshape(-1))
+    return float(auc.result(state))
