@@ -18,6 +18,7 @@ than substring-matching arbitrary exception text.
 """
 
 import functools
+import os
 import json
 import sys
 import time
@@ -35,24 +36,47 @@ BASELINE_TINY_1GPU_MS = 24.433
 BASELINE_BATCH = 65536
 
 
-def _init_backend_with_retry(attempts: int = 4, backoff_s: float = 20.0):
-    """jax.devices() with retry: TPU plugin init over the tunnel can throw a
-    transient UNAVAILABLE (seen in BENCH_r01). Returns the device list."""
-    last = None
+def _probe_backend_subprocess(timeout_s: float) -> bool:
+    """Probe device init in a THROWAWAY subprocess. Round-2 postmortem: a
+    wedged tunnel claim makes jax.devices() HANG (not raise), so an
+    in-process retry loop never regains control. A subprocess can be killed
+    and retried; only when the probe succeeds do we init in-process."""
+    import subprocess
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "import jax.numpy as jnp; "
+             "(jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready(); "
+             "print(d[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _init_backend_with_retry(attempts: int = 5, backoff_s: float = 30.0,
+                             probe_timeout_s: float = 150.0):
+    """Device init with hang-proof retry (see _probe_backend_subprocess).
+    Returns the device list."""
+    attempts = int(os.environ.get("DET_BENCH_INIT_ATTEMPTS", attempts))
+    last_err = "backend probe timed out (wedged tunnel claim?)"
     for i in range(attempts):
-        try:
-            return jax.devices()
-        except RuntimeError as e:  # jax re-raises init failures as RuntimeError
-            last = e
-            print(f"backend init attempt {i + 1}/{attempts} failed: "
-                  f"{str(e)[:200]}", file=sys.stderr, flush=True)
+        if _probe_backend_subprocess(probe_timeout_s):
             try:
-                jax.extend.backend.clear_backends()
-            except Exception:  # noqa: BLE001 - best-effort cache clear
-                pass
-            if i + 1 < attempts:
-                time.sleep(backoff_s * (i + 1))
-    raise last
+                return jax.devices()
+            except RuntimeError as e:
+                last_err = str(e)[:300]
+        print(f"backend init attempt {i + 1}/{attempts} failed: {last_err}",
+              file=sys.stderr, flush=True)
+        try:
+            jax.extend.backend.clear_backends()
+        except Exception:  # noqa: BLE001 - best-effort cache clear
+            pass
+        if i + 1 < attempts:
+            time.sleep(backoff_s * (i + 1))
+    raise RuntimeError(f"TPU backend unavailable after {attempts} attempts: "
+                       f"{last_err}")
 
 
 def _is_oom(e: Exception) -> bool:
