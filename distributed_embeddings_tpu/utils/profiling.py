@@ -110,3 +110,25 @@ def trace(logdir: str, host_tracer_level: int = 2):
 def annotate(name: str):
     """Named region that shows up in profiler traces (TraceAnnotation)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+def benchmark_chained(step: Callable, state, iters: int = 20) -> BenchResult:
+    """Steady-state timing of `state -> state` work as ONE device program:
+    a jitted fori_loop executes `step` `iters` times with the carried state
+    forcing inter-iteration dependencies. Immune to per-dispatch latency and
+    async-dispatch ambiguity (both observed to distort per-call timing over
+    remote-attached TPUs); wall-clock / iters is pure device time.
+    """
+    from jax import lax
+
+    lf = jax.jit(lambda s: lax.fori_loop(0, iters, lambda i, s: step(s), s))
+    t0 = time.perf_counter()
+    out = lf(state)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = lf(state)
+    jax.block_until_ready(out)
+    per_iter = (time.perf_counter() - t0) / iters
+    return BenchResult(mean_s=per_iter, p50_s=per_iter, min_s=per_iter,
+                       iters=iters, compile_s=compile_s)
