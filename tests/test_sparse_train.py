@@ -243,6 +243,26 @@ def test_sparse_train_mp_input_matches_dp():
                                    err_msg=f"table {t}")
 
 
+def test_sparse_train_ragged_inputs():
+    """RaggedIds flow through make_taps / residuals / sparse updates (the
+    padded slots must contribute nothing)."""
+    from distributed_embeddings_tpu.ops.embedding_ops import RaggedIds
+
+    rng_r = np.random.RandomState(55)
+
+    def inputs_fn(rng, i, s):
+        lengths = rng_r.randint(1, 5, size=BATCH)
+        values = rng_r.randint(0, s[0], size=int(lengths.sum()))
+        splits = np.cumsum([0] + list(lengths)).astype(np.int32)
+        return RaggedIds(jnp.asarray(values.astype(np.int32)),
+                         jnp.asarray(splits))
+
+    specs = [(40, 4, "sum"), (60, 8, "mean"), (30, 4, "sum"), (50, 8, "sum"),
+             (25, 4, "sum"), (70, 8, "mean"), (45, 4, "sum"), (35, 8, "sum")]
+    run_equivalence(specs, "adagrad", inputs_fn=inputs_fn,
+                    input_max_hotness=[6] * 8)
+
+
 def test_sparse_train_weighted_inputs():
     rng_w = np.random.RandomState(99)
 
