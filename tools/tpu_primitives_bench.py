@@ -6,6 +6,8 @@ input), so XLA cannot hoist, DCE, or overlap the work away; the tunnel
 dispatch cost is paid once.
 """
 
+import argparse
+import json
 import sys
 import time
 
@@ -14,9 +16,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+RESULTS = {}
+_ITERS = 10
 
-def timed_chain(make_fn, init_state, iters=10, label=""):
+
+def timed_chain(make_fn, init_state, iters=None, label="", n_rows=None):
     """make_fn: state -> state (same pytree structure/shapes)."""
+    iters = iters or _ITERS
+
     def loop(state):
         def body(i, s):
             return make_fn(s)
@@ -30,10 +37,18 @@ def timed_chain(make_fn, init_state, iters=10, label=""):
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / iters
     print(f"{label}: {dt * 1e3:.3f} ms/iter", flush=True)
+    RESULTS[label] = {"ms": round(dt * 1e3, 3)}
+    if n_rows:
+        RESULTS[label]["ns_per_row"] = round(dt / n_rows * 1e9, 1)
     return dt
 
 
 def main():
+    global _ITERS
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+    _ITERS = args.iters
     dev = jax.devices()[0]
     print(f"device: {dev.platform} {dev.device_kind}", flush=True)
     rng = np.random.default_rng(0)
@@ -122,6 +137,25 @@ def main():
         out = jnp.take(r, pm, axis=0)
         return out, pm
     timed_chain(step7, (rows, perm), label="permute 720k x 16 rows")
+
+    # 8. fused sparse-adagrad row update (the bench's per-bucket backward
+    # cost; decides DET_SPARSE_DENSE_MAX), both dedup strategies
+    from distributed_embeddings_tpu.ops import sparse_update as su
+    tbl = jnp.zeros((v, 16), jnp.float32)
+    acc = jnp.full((v, 16), 0.1, jnp.float32)
+    sids = jnp.asarray(rng.integers(0, v, n).astype(np.int32))
+    contribs = jnp.asarray(rng.standard_normal((n, 16), dtype=np.float32))
+    for strat in ("sort", "dense"):
+        def step8(s, strat=strat):
+            t, a, i = s
+            t2, a2 = su.sparse_adagrad(t, a, su.SparseRowGrad(i, contribs),
+                                       0.01, strategy=strat)
+            return t2, a2, (i * 1103515245 + 12345) % v
+        timed_chain(step8, (tbl, acc, sids),
+                    label=f"sparse_adagrad[{strat}] n=720k V=25M",
+                    n_rows=n)
+
+    print(json.dumps(RESULTS), flush=True)
 
 
 if __name__ == "__main__":

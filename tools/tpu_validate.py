@@ -1,11 +1,16 @@
 """One-shot hardware validation: run serially when the TPU tunnel is alive.
 
+Design (round-2 hardware postmortem): the tunnel claim can wedge and a
+wedged `jax.devices()` HANGS rather than raising, so the orchestrator must
+never touch the TPU itself. Every stage runs in its OWN subprocess with a
+timeout; a killed stage loses only that stage. A cooldown between stages
+lets the previous claim release cleanly before the next process claims.
+
 Stages (each skippable via --skip):
   1. probe    — backend init + tiny matmul (fail fast if tunnel is wedged)
-  2. prims    — ground-truth gather/scatter/sort rates via scanned chains
-                (one device program per measurement; wall-clock is device time)
-  3. pallas   — compiled-kernel correctness vs XLA (tools/tpu_pallas_check)
-  4. bench    — bench.py end to end
+  2. pallas   — compiled-kernel correctness vs XLA (tools/tpu_pallas_check)
+  3. bench    — bench.py end to end (its own supervisor adds retries)
+  4. prims    — ground-truth gather/scatter/sort rates via scanned chains
 
 Writes a JSON summary to tools/tpu_validate_out.json.
 
@@ -14,151 +19,98 @@ Usage: python tools/tpu_validate.py [--skip prims,pallas] [--iters 8]
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 import time
 
-import numpy as np
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+PROBE_SRC = (
+    "import time,jax,jax.numpy as jnp;"
+    "t0=time.perf_counter();d=jax.devices();"
+    "print('devices',d,round(time.perf_counter()-t0,1));"
+    "t0=time.perf_counter();"
+    "jax.block_until_ready(jnp.ones((512,512))@jnp.ones((512,512)));"
+    "print('matmul_s',round(time.perf_counter()-t0,1))"
+)
 
 
-def stage_probe():
-    import jax
-    import jax.numpy as jnp
+def run_stage(cmd, timeout_s):
+    """Run one stage in its own PROCESS GROUP so a timeout kills the whole
+    tree (bench.py spawns an inner child; killing only the parent would
+    leave the grandchild holding the TPU claim into the next stage).
+    Partial stdout/stderr of a timed-out stage is preserved — it says where
+    the stage hung."""
+    import signal
     t0 = time.perf_counter()
-    devs = jax.devices()
-    out = {"devices": str(devs), "init_s": round(time.perf_counter() - t0, 1)}
-    t0 = time.perf_counter()
-    jax.block_until_ready(jnp.ones((512, 512)) @ jnp.ones((512, 512)))
-    out["matmul_s"] = round(time.perf_counter() - t0, 1)
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, cwd=REPO, start_new_session=True)
+
+    # if WE are killed (driver timeout), take the stage's process group down
+    # with us — an orphaned stage child would hold the TPU claim forever
+    def _reap(signum, frame):
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        raise SystemExit(128 + signum)
+
+    old = [signal.signal(s, _reap) for s in (signal.SIGTERM, signal.SIGINT)]
+    try:
+        stdout, stderr = p.communicate(timeout=timeout_s)
+        out = {"rc": p.returncode, "out": stdout[-3000:]}
+        if p.returncode:
+            out["err"] = stderr[-1200:]
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        stdout, stderr = p.communicate()
+        out = {"rc": -1,
+               "err": f"timed out after {timeout_s:.0f}s "
+                      "(wedged tunnel claim?)",
+               "out": (stdout or "")[-2000:],
+               "err_tail": (stderr or "")[-1200:]}
+    finally:
+        for s, h in zip((signal.SIGTERM, signal.SIGINT), old):
+            signal.signal(s, h)
+    out["stage_s"] = round(time.perf_counter() - t0, 1)
     return out
-
-
-def _chain_time(body, state, iters):
-    """Wall-time of ONE jitted program executing `body` iters times with a
-    forced inter-iteration data dependency."""
-    import jax
-    from jax import lax
-    lf = jax.jit(lambda s: lax.fori_loop(0, iters, lambda i, s: body(s), s))
-    out = lf(state)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    out = lf(state)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
-
-
-def stage_prims(iters):
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-    rng = np.random.default_rng(0)
-    res = {}
-    v = 25_000_000
-    tab16 = jnp.zeros((v, 16), jnp.float32)
-    tab128 = jnp.zeros((2_000_000, 128), jnp.float32)
-
-    # gather rate, narrow + wide rows (ids derived from prior output)
-    for label, tab, vv, n in (("gather_65k_w16_v25M", tab16, v, 65536),
-                              ("gather_720k_w16_v25M", tab16, v, 720896),
-                              ("gather_65k_w128_v2M", tab128, 2_000_000,
-                               65536)):
-        ids = jnp.asarray(rng.integers(0, vv, n).astype(np.int32))
-
-        def body(s, tab=tab, vv=vv):
-            i, acc = s
-            out = jnp.take(tab, i, axis=0)
-            return ((i * 1103515245 + 12345) % vv,
-                    acc + out[0, 0].astype(jnp.float32))
-        dt = _chain_time(body, (ids, jnp.float32(0)), iters)
-        res[label] = {"ms": round(dt * 1e3, 3),
-                      "ns_per_row": round(dt / n * 1e9, 1)}
-
-    # scatter-add rate into a big table
-    ids = jnp.asarray(rng.integers(0, v, 720896).astype(np.int32))
-    rows = jnp.asarray(rng.standard_normal((720896, 16), dtype=np.float32))
-
-    def body_sc(s):
-        i, acc = s
-        buf = jnp.zeros((v, 16), jnp.float32).at[i].add(rows)
-        return (i * 1103515245 + 12345) % v, acc + buf[0, 0]
-    dt = _chain_time(body_sc, (ids, jnp.float32(0)), max(2, iters // 2))
-    res["scatter_720k_w16_v25M"] = {"ms": round(dt * 1e3, 3),
-                                    "ns_per_row": round(dt / 720896 * 1e9, 1)}
-
-    # sort rate (key feeds back)
-    for n in (720896, 2883584):
-        k = jnp.asarray(rng.integers(0, v, n).astype(np.int32))
-        pv = jnp.arange(n, dtype=jnp.int32)
-
-        def body_s(s):
-            k, p = s
-            ks, vs = lax.sort_key_val(k, p)
-            return (ks * 1103515245 + 12345) & 0x7fffffff, vs
-        dt = _chain_time(body_s, (k, pv), iters)
-        res[f"sort_{n}"] = {"ms": round(dt * 1e3, 3)}
-
-    # fused sparse-adagrad update (the bench's per-bucket backward cost)
-    from distributed_embeddings_tpu.ops import sparse_update as su
-    tbl = jnp.zeros((v, 16), jnp.float32)
-    acc = jnp.full((v, 16), 0.1, jnp.float32)
-    contribs = jnp.asarray(rng.standard_normal((720896, 16),
-                                               dtype=np.float32))
-
-    def body_up(s):
-        t, a, i = s
-        t2, a2 = su.sparse_adagrad(t, a, su.SparseRowGrad(i, contribs), 0.01,
-                                   strategy="sort")
-        return t2, a2, (i * 1103515245 + 12345) % v
-    dt = _chain_time(body_up, (tbl, acc, ids), max(2, iters // 2))
-    res["sparse_adagrad_720k_v25M"] = {"ms": round(dt * 1e3, 3)}
-    return res
-
-
-def stage_pallas():
-    p = subprocess.run([sys.executable, "tools/tpu_pallas_check.py",
-                       "--quick"], capture_output=True, text=True,
-                      timeout=1800)
-    return {"rc": p.returncode, "out": p.stdout[-2000:],
-            "err": p.stderr[-500:] if p.returncode else ""}
-
-
-def stage_bench():
-    p = subprocess.run([sys.executable, "bench.py"], capture_output=True,
-                       text=True, timeout=3600)
-    line = None
-    for ln in p.stdout.splitlines():
-        if ln.startswith("{"):
-            line = ln
-    return {"rc": p.returncode, "json": line,
-            "err": p.stderr[-800:] if p.returncode else ""}
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip", default="")
-    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=6)
+    ap.add_argument("--cooldown", type=float, default=20.0)
     ap.add_argument("--out", default="tools/tpu_validate_out.json")
     args = ap.parse_args()
     skip = set(args.skip.split(",")) if args.skip else set()
+
+    stages = [
+        ("probe", [sys.executable, "-u", "-c", PROBE_SRC], 240),
+        ("pallas", [sys.executable, "-u", "tools/tpu_pallas_check.py",
+                    "--quick"], 1800),
+        ("bench", [sys.executable, "-u", "bench.py"], 3600 * 3),
+        ("prims", [sys.executable, "-u", "tools/tpu_primitives_bench.py",
+                   "--iters", str(args.iters)], 1800),
+    ]
     summary = {}
-    for name, fn in (("probe", stage_probe),
-                     ("prims", lambda: stage_prims(args.iters)),
-                     ("pallas", stage_pallas),
-                     ("bench", stage_bench)):
+    for i, (name, cmd, timeout_s) in enumerate(stages):
         if name in skip:
             continue
-        t0 = time.perf_counter()
-        try:
-            summary[name] = fn()
-        except Exception as e:  # noqa: BLE001
-            summary[name] = {"error": str(e)[:500]}
-            print(f"stage {name} FAILED: {str(e)[:200]}", flush=True)
-            if name == "probe":
-                break
-        summary[name]["stage_s"] = round(time.perf_counter() - t0, 1)
-        print(f"stage {name}: {json.dumps(summary[name])[:400]}", flush=True)
-    with open(args.out, "w") as f:
-        json.dump(summary, f, indent=1)
+        summary[name] = run_stage(cmd, timeout_s)
+        print(f"stage {name}: {json.dumps(summary[name])[:500]}", flush=True)
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1)
+        if name == "probe" and summary[name]["rc"] != 0:
+            print("probe failed; aborting remaining stages", flush=True)
+            break
+        if i + 1 < len(stages):
+            time.sleep(args.cooldown)
     print("WROTE", args.out)
 
 
