@@ -1638,9 +1638,16 @@ class DistributedEmbedding:
                              return_residuals=return_residuals)
 
     # --------------------------------------------------------- weights I/O
-    def _shard_host(self, arr: jax.Array, rank: int) -> np.ndarray:
+    def _shard_host(self, arr: jax.Array, rank: int,
+                    cache: Optional[dict] = None) -> np.ndarray:
         """One rank's [rows_max, w] block of a stacked param, fetched
-        shard-wise (never materializing the global stack on host)."""
+        shard-wise (never materializing the global stack on host). Remote
+        ranks' shards (multi-process runs) come from the pre-gathered
+        `cache` — see get_weights, which issues the collective gathers in a
+        fixed order BEFORE any per-rank reads (a conditional gather here
+        would run collectives in a process-dependent order and deadlock)."""
+        if cache and id(arr) in cache:
+            return cache[id(arr)][rank]
         if hasattr(arr, "addressable_shards"):
             for sh in arr.addressable_shards:
                 idx = sh.index[0]
@@ -1653,10 +1660,21 @@ class DistributedEmbedding:
     def get_weights(self, params, all_ranks: bool = False) -> List[np.ndarray]:
         """Reassemble global per-table weights in original table order
         (reference get_weights :1139-1162), reading device shards one at a
-        time. On a single host this is direct shard access; multi-host
-        callers should wrap with process_allgather.
+        time. Multi-process: every non-fully-addressable stacked param is
+        first replicated host-side by a collective all-gather, in fixed
+        (tp-bucket, row-table) order — so ALL processes must call
+        get_weights together (the reference's get_weights is likewise
+        collective, :1084-1089).
         """
         del all_ranks  # SPMD: every process sees the global jax.Array
+        cache: dict = {}
+        if self.mesh is not None and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            for arr in list(params["tp"]) + list(params["row"]):
+                if (hasattr(arr, "is_fully_addressable")
+                        and not arr.is_fully_addressable):
+                    cache[id(arr)] = np.asarray(
+                        multihost_utils.process_allgather(arr, tiled=True))
         strat = self.strategy
         n = len(strat.global_configs)
         out: List[Optional[np.ndarray]] = [None] * n
@@ -1669,14 +1687,15 @@ class DistributedEmbedding:
             for pl_ in sorted((p for p in self.plan.tp_placements
                                if p.table_id == t_local),
                               key=lambda p: p.col_start):
-                shard = self._shard_host(params["tp"][pl_.bucket], pl_.rank)
+                shard = self._shard_host(params["tp"][pl_.bucket], pl_.rank,
+                                         cache)
                 cols.append(shard[pl_.row_offset:pl_.row_offset + pl_.rows, :])
             out[gtid] = np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
 
         for t_local, gtid in enumerate(strat.table_groups[2]):
             rt = self.plan.row_tables[t_local]
-            parts = [self._shard_host(params["row"][t_local],
-                                      r)[:rt.rows_per_rank[r], :]
+            parts = [self._shard_host(params["row"][t_local], r,
+                                      cache)[:rt.rows_per_rank[r], :]
                      for r in range(self.world_size)]
             out[gtid] = np.concatenate(parts, axis=0)
         return out

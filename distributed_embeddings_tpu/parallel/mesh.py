@@ -43,12 +43,19 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     ride ICI within a slice and DCN across slices — the layout the
     scaling-book recipe prescribes for a single combined dp/mp axis.
     """
+    # NOTE: must not touch jax.process_count()/jax.devices() here — any
+    # backend-initializing call before jax.distributed.initialize() makes
+    # the bootstrap fail ("must be called before any JAX calls ...")
+    already = False
     try:
         from jax._src.distributed import global_state
         already = global_state.client is not None
     except Exception:  # noqa: BLE001 - internal layout differs by version
-        already = False
-    if jax.process_count() > 1 or already:
+        try:  # public form on newer jax
+            already = bool(jax.distributed.is_initialized())
+        except Exception:  # noqa: BLE001
+            already = False
+    if already:
         return
     kwargs = {}
     if coordinator_address is not None:
@@ -60,6 +67,10 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     try:
         jax.distributed.initialize(**kwargs)
     except (ValueError, RuntimeError) as e:
+        # idempotence even when the already-initialized probe above had no
+        # usable API: a repeat call is a no-op, not an error
+        if "already" in str(e).lower() and "initialize" in str(e).lower():
+            return
         # single-process runs (no coordinator discoverable) stay local
         if coordinator_address is not None:
             raise

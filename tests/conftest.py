@@ -24,5 +24,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-minute tests (multi-process spawns)")
+
 assert len(jax.devices()) >= 8, (
     f"tests need 8 virtual CPU devices, got {jax.devices()}")

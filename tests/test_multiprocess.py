@@ -1,0 +1,68 @@
+"""True multi-process SPMD validation over a shared CPU mesh.
+
+The reference's test harness runs every distributed case under real
+multiprocess Horovod (`horovodrun -np N`, reference
+dist_model_parallel_test.py; SURVEY.md §4). The single-process 8-device
+tests elsewhere in this suite cover the SPMD *math*; this file covers the
+multi-process *mechanics* the math can't see: jax.distributed bootstrap
+(gloo), per-process shard staging in set_weights/init, cross-process
+collectives inside shard_map, and process-local input staging.
+
+Topology: 2 processes x 4 virtual CPU devices = the same 8-device mesh the
+rest of the suite uses, so checksums are comparable with a 1-process run of
+the identical worker (world-size-generic, like the reference's tests).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run(nproc: int, local_devices: int, out: str, timeout=420):
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", WORKER, "--pid", str(pid),
+             "--nproc", str(nproc), "--port", str(port),
+             "--local_devices", str(local_devices), "--out", out],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for pid in range(nproc)
+    ]
+    logs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(stdout)
+    for pid, (p, log) in enumerate(zip(procs, logs)):
+        assert p.returncode == 0, (
+            f"worker {pid}/{nproc} rc={p.returncode}:\n{log[-3000:]}")
+    with open(out) as f:
+        return json.load(f)
+
+
+@pytest.mark.slow
+def test_two_process_matches_single_process(tmp_path):
+    multi = _run(2, 4, str(tmp_path / "mp2.json"))
+    single = _run(1, 8, str(tmp_path / "mp1.json"))
+    assert multi == single, (multi, single)
