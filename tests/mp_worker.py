@@ -113,6 +113,53 @@ def main():
     got = dist.get_weights(params)
     checks["weights"] = [round(float(np.sum(np.abs(w))), 3) for w in got]
 
+    # sparse tapped train step (the production path): row-wise adagrad
+    # updates flowing through shard_map across processes
+    from distributed_embeddings_tpu.ops.sparse_update import (
+        make_sparse_optimizer)
+    sopt = make_sparse_optimizer("adagrad", 0.1)
+    sstate = dist.init_sparse_state(params, sopt)
+
+    def tap_loss(taps, p, xs):
+        outs, res = dist.apply(p, xs, taps=taps, return_residuals=True)
+        return sum(jnp.sum(o * o) for o in outs) / batch, res
+
+    @jax.jit
+    def sparse_step(p, s, xs):
+        taps = dist.make_taps(xs)
+        (loss, res), g_taps = jax.value_and_grad(
+            tap_loss, has_aux=True)(taps, p, xs)
+        new_p, new_s, _pending = dist.sparse_update(p, s, g_taps, res, sopt)
+        return new_p, new_s, loss
+
+    sparams, sstate, sloss = sparse_step(params, sstate, inputs)
+    checks["sparse_loss"] = round(float(sloss), 5)
+    checks["sparse_fwd"] = [round(float(s), 4)
+                            for s in fwd(sparams, inputs)]
+
+    # dp_input=False: each process supplies only its own ranks' features
+    # (remote ranks are None), global batch everywhere
+    dist_mp = DistributedEmbedding(
+        [Embedding(v, w, combiner=None) for v, w in sizes[1:-1]], mesh=mesh,
+        strategy="memory_balanced", dp_input=False,
+        input_max_hotness=[1] * len(sizes[1:-1]))
+    mp_params = dist_mp.set_weights(weights[1:-1])
+    local_ranks = {r for r, _ in dist_mp._rank_of_device()}
+    mp_inputs = []
+    for r, rank_ids in enumerate(dist_mp.strategy.input_ids_list):
+        if r not in local_ranks:
+            mp_inputs.append(None)
+            continue
+        rr = np.random.RandomState(100 + r)
+        mp_inputs.append([
+            jnp.asarray(rr.randint(
+                0, sizes[1:-1][dist_mp.strategy.input_groups[1][pos]][0],
+                size=batch).astype(np.int32))
+            for pos in rank_ids])
+    mp_outs = dist_mp.apply_mp(mp_params, mp_inputs)
+    sums = jax.jit(lambda *os: [jnp.sum(o * o) for o in os])(*mp_outs)
+    checks["mp_fwd"] = [round(float(s), 4) for s in sums]
+
     if args.pid == 0:
         with open(args.out, "w") as f:
             json.dump(checks, f)
