@@ -229,6 +229,10 @@ def supervise() -> int:
     per_try_s = float(os.environ.get("DET_BENCH_TRY_TIMEOUT_S", 3300))
     backoff_s = float(os.environ.get("DET_BENCH_BACKOFF_S", 120))
     env = dict(os.environ, DET_BENCH_INNER="1")
+    # persistent compile cache: an attempt killed mid-measurement leaves its
+    # compiles behind for the retry (tunnel compiles are the slow part)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_det_tpu")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     last = ""
     for i in range(attempts):
         try:

@@ -89,6 +89,10 @@ def main():
     ap.add_argument("--out", default="tools/tpu_validate_out.json")
     args = ap.parse_args()
     skip = set(args.skip.split(",")) if args.skip else set()
+    # share one persistent compile cache across stages and retries
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/jax_cache_det_tpu")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
     stages = [
         ("probe", [sys.executable, "-u", "-c", PROBE_SRC], 240),
