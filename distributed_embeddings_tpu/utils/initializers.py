@@ -14,7 +14,7 @@ from typing import Callable, Sequence, Union
 import jax
 import jax.numpy as jnp
 
-InitializerSpec = Union[str, Callable]
+InitializerSpec = Union[str, dict, Callable]
 
 
 def _uniform(scale: float):
@@ -55,15 +55,54 @@ _REGISTRY = {
 }
 
 
+def _from_keras_config(class_name: str, config: dict) -> Callable:
+    """Keras-serialized initializer dicts ({'class_name', 'config'}) — the
+    form keras `get_config()` emits and the reference's planner IR carries
+    through slicing/concat (reference dist_model_parallel.py:363-366)."""
+    name = class_name.lower()
+    if name in ("randomuniform", "random_uniform", "uniform"):
+        lo = config.get("minval", -0.05)
+        hi = config.get("maxval", 0.05)
+
+        def init(key, shape, dtype=jnp.float32):
+            return jax.random.uniform(key, shape, dtype, minval=lo, maxval=hi)
+        return init
+    if name in ("randomnormal", "random_normal", "truncatednormal",
+                "truncated_normal", "normal"):
+        mean = config.get("mean", 0.0)
+        stddev = config.get("stddev", 0.05)
+
+        def init(key, shape, dtype=jnp.float32):
+            draw = (jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+                    if "truncated" in name
+                    else jax.random.normal(key, shape, dtype))
+            return draw * stddev + mean
+        return init
+    if name in ("zeros", "ones", "glorot_uniform", "glorotuniform"):
+        return _REGISTRY["glorot_uniform" if "glorot" in name else name]
+    if name == "constant":
+        value = config.get("value", 0.0)
+
+        def init(key, shape, dtype=jnp.float32):
+            del key
+            return jnp.full(shape, value, dtype)
+        return init
+    raise ValueError(f"Unknown keras initializer class '{class_name}'")
+
+
 def get_initializer(spec: InitializerSpec) -> Callable:
-    """Resolve a named or callable initializer spec."""
+    """Resolve an initializer spec: a callable, a registry name, or a
+    keras-serialized {'class_name', 'config'} dict."""
     if callable(spec):
         return spec
     if isinstance(spec, str):
         if spec not in _REGISTRY:
             raise ValueError(f"Unknown initializer '{spec}'")
         return _REGISTRY[spec]
-    raise TypeError(f"Initializer spec must be str or callable, got {type(spec)}")
+    if isinstance(spec, dict) and "class_name" in spec:
+        return _from_keras_config(spec["class_name"], spec.get("config") or {})
+    raise TypeError(f"Initializer spec must be str, keras config dict or "
+                    f"callable, got {type(spec)}")
 
 
 class ConcatInitializer:
