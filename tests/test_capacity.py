@@ -1,0 +1,30 @@
+"""Sanity of the capacity planner's accounting (tools/capacity.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from capacity import per_chip_bytes  # noqa: E402
+
+
+def test_tiny_single_chip_accounting():
+    acct = per_chip_bytes("tiny", 1, 65536)
+    # tiny tables are 4.19 GiB fp32; adagrad doubles it
+    assert abs(acct["tables"] / 2**30 - 4.19) < 0.1
+    assert acct["opt_state"] == acct["tables"]
+    assert acct["total"] < 16 * 2**30  # fits one v5e
+
+
+def test_per_chip_shrinks_with_world():
+    sizes = [per_chip_bytes("small", w, 65536)["tables"]
+             for w in (1, 8, 64)]
+    assert sizes[0] > sizes[1] > sizes[2]
+    # at 64 chips the per-chip share is within 4x of perfect balance
+    perfect = sizes[0] / 64
+    assert sizes[2] < 4 * perfect
+
+
+def test_sgd_has_no_state():
+    acct = per_chip_bytes("tiny", 8, 65536, optimizer="sgd")
+    assert acct["opt_state"] == 0

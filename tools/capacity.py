@@ -1,0 +1,109 @@
+"""Pod capacity planner: which synthetic scale fits which TPU pod?
+
+Uses the (device-free) sharding planner to place every synthetic model at a
+range of world sizes and reports per-chip HBM need — allocated stacked
+buckets (padding included), optimizer state, and a batch-dependent
+activation estimate — against v5e/v5p HBM. This answers BASELINE.json's
+"max embedding params shardable per pod" capacity metric without hardware:
+the plan IS the allocation.
+
+Usage: python tools/capacity.py [--models tiny,small,...] [--batch 65536]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..")))  # repo root
+
+HBM_BYTES = {"v5e": 16 * 2**30, "v5p": 95 * 2**30}
+
+
+def per_chip_bytes(model_key: str, world: int, batch: int,
+                   optimizer: str = "adagrad"):
+    """Plan `model_key` at `world` chips; return per-chip byte accounting."""
+    from distributed_embeddings_tpu.models.synthetic import (
+        SYNTHETIC_MODELS, expand_embedding_configs)
+    from distributed_embeddings_tpu.layers.embedding import Embedding
+    from distributed_embeddings_tpu.parallel.planner import (
+        DistEmbeddingStrategy)
+    from distributed_embeddings_tpu.parallel.plan import lower_strategy
+
+    cfg = SYNTHETIC_MODELS[model_key]
+    specs, input_table_map, hotness = expand_embedding_configs(cfg)
+    embs = [Embedding(v, w, combiner="sum") for (v, w) in specs]
+    # fair-share slicing thresholds: any table bigger than its per-chip
+    # share is column-sliced; the monsters (> 4 shares) are row-sliced
+    # across the whole pod. Stacked buckets allocate rows_max on EVERY
+    # chip, so unsliced giants would cost their full size per chip.
+    total = sum(v * w for v, w in specs)
+    share = max(total // world, 1)
+    strat = DistEmbeddingStrategy(
+        embs, world, "memory_balanced", input_table_map=input_table_map,
+        column_slice_threshold=share,
+        row_slice_threshold=(4 * share if world > 1 else None))
+    plan = lower_strategy(strat)
+
+    # stacked allocations are [world, rows_max, width]: every chip holds
+    # rows_max rows per bucket/row-table (padding included — that is what
+    # the runtime actually allocates per chip)
+    table_b = sum(max(b.rows_max, 1) * b.width * 4 for b in plan.tp_buckets)
+    table_b += sum(max(rt.rows_max, 1) * rt.width * 4
+                   for rt in plan.row_tables)
+    # dp tables are replicated on every chip
+    table_b += sum(c["input_dim"] * c["output_dim"] * 4
+                   for c in strat.dp_configs)
+    opt_mult = {"sgd": 0, "adagrad": 1, "adam": 2}[optimizer]
+    state_b = table_b * opt_mult
+
+    # activation estimate: per-chip batch shard of looked-up rows (fwd out +
+    # tap grads ~ 2x) plus exchanged id blocks
+    b_local = max(batch // world, 1)
+    act_rows = sum(h * specs[t][1] for t, h in
+                   zip(input_table_map, hotness))
+    act_b = 2 * b_local * act_rows * 4 + b_local * sum(hotness) * 4 * 2
+    return {"tables": table_b, "opt_state": state_b, "activations": act_b,
+            "total": table_b + state_b + act_b}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", default="tiny,small,medium,large,jumbo,colossal")
+    ap.add_argument("--worlds", default="1,8,16,32,64,128,256,512")
+    ap.add_argument("--batch", type=int, default=65536)
+    ap.add_argument("--optimizer", default="adagrad")
+    args = ap.parse_args()
+
+    worlds = [int(w) for w in args.worlds.split(",")]
+    out = {}
+    for m in args.models.split(","):
+        rows = {}
+        for w in worlds:
+            try:
+                acct = per_chip_bytes(m, w, args.batch, args.optimizer)
+            except Exception as e:  # noqa: BLE001 - report placement failure
+                rows[w] = {"error": str(e)[:120]}
+                continue
+            fits = {gen: acct["total"] <= cap * 0.9  # 10% runtime headroom
+                    for gen, cap in HBM_BYTES.items()}
+            rows[w] = {"per_chip_gib": round(acct["total"] / 2**30, 2),
+                       "tables_gib": round(acct["tables"] / 2**30, 2),
+                       **{f"fits_{g}": f for g, f in fits.items()}}
+        out[m] = rows
+        min_fit = {g: next((w for w in worlds
+                            if rows.get(w, {}).get(f"fits_{g}")), None)
+                   for g in HBM_BYTES}
+        print(f"{m:9s} min chips: "
+              + "  ".join(f"{g}={min_fit[g]}" for g in HBM_BYTES)
+              + "   (per-chip GiB at that size: "
+              + "  ".join(
+                  f"{g}:{rows[min_fit[g]]['per_chip_gib']}"
+                  if min_fit[g] else f"{g}:-" for g in HBM_BYTES) + ")",
+              flush=True)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
