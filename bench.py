@@ -301,6 +301,25 @@ def main():
             "unit": "ms",
             "vs_baseline": round(throughput / baseline_throughput, 3),
         }
+        try:
+            from distributed_embeddings_tpu.models.synthetic import (
+                expand_embedding_configs)
+            specs, tmap, hot = expand_embedding_configs(cfg)
+            widths = [specs[t][1] for t in tmap]
+            mlp = ([sum(widths) + cfg.num_numerical_features]
+                   + list(cfg.mlp_sizes) + [1])
+            emb_b, mlp_f = dlrm_roofline_bytes_flops(widths, hot, mlp)
+            gen_name = _chip_gen(jax.devices()[0])
+            bound_s = max(batch * emb_b / (HBM_GBPS[gen_name] * 1e9),
+                          batch * mlp_f / (BF16_TFLOPS[gen_name] * 1e12))
+            record["tiny_roofline_step_ms"] = round(bound_s * 1e3, 3)
+            record["tiny_roofline_frac"] = round(bound_s / dt, 3)
+            stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+            if stats and stats.get("peak_bytes_in_use"):
+                record["hbm_peak_gib"] = round(
+                    stats["peak_bytes_in_use"] / 2**30, 2)
+        except Exception:  # noqa: BLE001 - never lose the primary metric
+            pass
         # secondary workload: DLRM samples/sec + HBM roofline (north-star
         # metric, BASELINE.json) — carried in the same single JSON line
         try:
