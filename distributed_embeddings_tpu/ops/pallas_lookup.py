@@ -224,10 +224,15 @@ def _dma_gather_lookup(table: jax.Array, ids: jax.Array, weights: jax.Array,
 # dispatch + autodiff
 # --------------------------------------------------------------------------
 def _fused_impl(params, ids, weights, interpret):
+    import os
     vocab, width = params.shape
     if vocab <= ONEHOT_MAX_VOCAB:
         return _onehot_lookup(params, ids, weights, interpret=interpret)
-    if width % _LANE == 0:
+    # narrow rows (< 1 lane) make per-row DMAs tiny; whether that still
+    # beats XLA's gather is a hardware question — opt in via env until the
+    # prims data answers it
+    narrow_ok = os.environ.get("DET_PALLAS_NARROW", "0") == "1"
+    if width % _LANE == 0 or (narrow_ok and width in (8, 16, 32, 64)):
         return _dma_gather_lookup(params, ids, weights, interpret=interpret)
     # XLA fallback: gather + weighted reduce (still fused by XLA)
     embs = jnp.take(params, ids, axis=0)
