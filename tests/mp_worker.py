@@ -34,6 +34,8 @@ def main():
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--local_devices", type=int, required=True)
     ap.add_argument("--out", required=True)
+    ap.add_argument("--ckpt", default=None,
+                    help="shared dir for the orbax checkpoint phase")
     args = ap.parse_args()
 
     os.environ["XLA_FLAGS"] = (
@@ -112,6 +114,18 @@ def main():
     # multi-process — every process calls it together)
     got = dist.get_weights(params)
     checks["weights"] = [round(float(np.sum(np.abs(w))), 3) for w in got]
+
+    # distributed orbax checkpoint: every process writes its own shards,
+    # restore honors the plan shardings (multi-host checkpoint/resume)
+    if args.ckpt:
+        from distributed_embeddings_tpu.utils import checkpoint as ckpt
+        ckpt.save_checkpoint(args.ckpt, params, force=True)
+        restored = ckpt.restore_checkpoint(
+            args.ckpt, params, shardings=dist.param_shardings())
+        checks["ckpt_fwd"] = [round(float(s), 4)
+                              for s in fwd(restored, inputs)]
+        assert checks["ckpt_fwd"] == checks["fwd2"], (
+            checks["ckpt_fwd"], checks["fwd2"])
 
     # sparse tapped train step (the production path): row-wise adagrad
     # updates flowing through shard_map across processes

@@ -32,7 +32,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run(nproc: int, local_devices: int, out: str, timeout=420):
+def _run(nproc: int, local_devices: int, out: str, ckpt=None, timeout=420):
     port = _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own device count
@@ -40,7 +40,8 @@ def _run(nproc: int, local_devices: int, out: str, timeout=420):
         subprocess.Popen(
             [sys.executable, "-u", WORKER, "--pid", str(pid),
              "--nproc", str(nproc), "--port", str(port),
-             "--local_devices", str(local_devices), "--out", out],
+             "--local_devices", str(local_devices), "--out", out]
+            + (["--ckpt", ckpt] if ckpt else []),
             env=env, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
         for pid in range(nproc)
@@ -63,6 +64,9 @@ def _run(nproc: int, local_devices: int, out: str, timeout=420):
 
 @pytest.mark.slow
 def test_two_process_matches_single_process(tmp_path):
-    multi = _run(2, 4, str(tmp_path / "mp2.json"))
-    single = _run(1, 8, str(tmp_path / "mp1.json"))
+    multi = _run(2, 4, str(tmp_path / "mp2.json"),
+                 ckpt=str(tmp_path / "ck2"))
+    single = _run(1, 8, str(tmp_path / "mp1.json"),
+                  ckpt=str(tmp_path / "ck1"))
+    assert "ckpt_fwd" in multi  # the distributed-checkpoint phase ran
     assert multi == single, (multi, single)
