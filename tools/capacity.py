@@ -22,8 +22,14 @@ HBM_BYTES = {"v5e": 16 * 2**30, "v5p": 95 * 2**30}
 
 
 def per_chip_bytes(model_key: str, world: int, batch: int,
-                   optimizer: str = "adagrad"):
-    """Plan `model_key` at `world` chips; return per-chip byte accounting."""
+                   optimizer: str = "adagrad",
+                   gpu_embedding_size=None):
+    """Plan `model_key` at `world` chips; return per-chip byte accounting.
+
+    gpu_embedding_size: per-chip element budget — buckets past it are
+    flagged for host offload (pinned host memory) and accounted under
+    'host' instead of HBM, like the runtime places them.
+    """
     from distributed_embeddings_tpu.models.synthetic import (
         SYNTHETIC_MODELS, expand_embedding_configs)
     from distributed_embeddings_tpu.layers.embedding import Embedding
@@ -43,13 +49,18 @@ def per_chip_bytes(model_key: str, world: int, batch: int,
     strat = DistEmbeddingStrategy(
         embs, world, "memory_balanced", input_table_map=input_table_map,
         column_slice_threshold=share,
-        row_slice_threshold=(4 * share if world > 1 else None))
+        row_slice_threshold=(4 * share if world > 1 else None),
+        gpu_embedding_size=gpu_embedding_size)
     plan = lower_strategy(strat)
 
     # stacked allocations are [world, rows_max, width]: every chip holds
     # rows_max rows per bucket/row-table (padding included — that is what
-    # the runtime actually allocates per chip)
-    table_b = sum(max(b.rows_max, 1) * b.width * 4 for b in plan.tp_buckets)
+    # the runtime actually allocates per chip). Offloaded buckets live in
+    # pinned host memory instead of HBM.
+    host_b = sum(max(b.rows_max, 1) * b.width * 4
+                 for b in plan.tp_buckets if b.offload)
+    table_b = sum(max(b.rows_max, 1) * b.width * 4
+                  for b in plan.tp_buckets if not b.offload)
     table_b += sum(max(rt.rows_max, 1) * rt.width * 4
                    for rt in plan.row_tables)
     # dp tables are replicated on every chip
@@ -57,6 +68,7 @@ def per_chip_bytes(model_key: str, world: int, batch: int,
                    for c in strat.dp_configs)
     opt_mult = {"sgd": 0, "adagrad": 1, "adam": 2}[optimizer]
     state_b = table_b * opt_mult
+    host_b *= 1 + opt_mult
 
     # activation estimate: per-chip batch shard of looked-up rows (fwd out +
     # tap grads ~ 2x) plus exchanged id blocks
@@ -65,7 +77,7 @@ def per_chip_bytes(model_key: str, world: int, batch: int,
                    zip(input_table_map, hotness))
     act_b = 2 * b_local * act_rows * 4 + b_local * sum(hotness) * 4 * 2
     return {"tables": table_b, "opt_state": state_b, "activations": act_b,
-            "total": table_b + state_b + act_b}
+            "host": host_b, "total": table_b + state_b + act_b}
 
 
 def main():
@@ -74,6 +86,9 @@ def main():
     ap.add_argument("--worlds", default="1,8,16,32,64,128,256,512")
     ap.add_argument("--batch", type=int, default=65536)
     ap.add_argument("--optimizer", default="adagrad")
+    ap.add_argument("--gpu_embedding_size", type=int, default=None,
+                    help="per-chip element budget; overflow buckets are "
+                         "host-offloaded (accounted under 'host_gib')")
     args = ap.parse_args()
 
     worlds = [int(w) for w in args.worlds.split(",")]
@@ -82,7 +97,8 @@ def main():
         rows = {}
         for w in worlds:
             try:
-                acct = per_chip_bytes(m, w, args.batch, args.optimizer)
+                acct = per_chip_bytes(m, w, args.batch, args.optimizer,
+                                      args.gpu_embedding_size)
             except Exception as e:  # noqa: BLE001 - report placement failure
                 rows[w] = {"error": str(e)[:120]}
                 continue
@@ -90,6 +106,8 @@ def main():
                     for gen, cap in HBM_BYTES.items()}
             rows[w] = {"per_chip_gib": round(acct["total"] / 2**30, 2),
                        "tables_gib": round(acct["tables"] / 2**30, 2),
+                       **({"host_gib": round(acct["host"] / 2**30, 2)}
+                          if acct["host"] else {}),
                        **{f"fits_{g}": f for g, f in fits.items()}}
         out[m] = rows
         min_fit = {g: next((w for w in worlds
