@@ -116,3 +116,19 @@ def test_get_initializer_specs(spec):
     assert w.shape == (16, 4)
     if spec == "zeros":
         np.testing.assert_allclose(np.asarray(w), 0.0)
+
+
+def test_set_weights_error_paths():
+    """Analogue of the reference's set_weight error test (:461): wrong
+    weight count / shape fail loudly, not silently."""
+    from distributed_embeddings_tpu.layers.dist_model_parallel import (
+        DistributedEmbedding)
+    from distributed_embeddings_tpu.layers.embedding import Embedding
+
+    dist = DistributedEmbedding([Embedding(10, 4), Embedding(20, 4)],
+                                mesh=create_mesh(jax.devices()[:8]))
+    with pytest.raises(ValueError, match="Expected 2 weights"):
+        dist.set_weights([np.zeros((10, 4), np.float32)])
+    with pytest.raises(ValueError, match="shape"):
+        dist.set_weights([np.zeros((10, 4), np.float32),
+                          np.zeros((21, 4), np.float32)])
