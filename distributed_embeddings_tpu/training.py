@@ -347,7 +347,15 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
             params = cb.on_train_begin(params)
 
     get_batch = data if callable(data) else None
-    it = iter(data) if get_batch is None else None
+    if get_batch is None:
+        # keep 2 batches staged ahead on device: host->HBM transfers overlap
+        # the async-dispatched previous step (reference's prefetch executor
+        # role, examples/dlrm/utils.py:231-254)
+        from distributed_embeddings_tpu.utils.prefetch import (
+            prefetch_to_device)
+        it = prefetch_to_device(data)
+    else:
+        it = None
     history = {"loss": []}
     for step in range(steps):
         batch = get_batch(step) if get_batch else next(it)

@@ -1,0 +1,48 @@
+"""Device-prefetching batch iterator.
+
+The reference's data path overlaps host reads with device compute via a
+1-thread prefetch executor (reference examples/dlrm/utils.py:231-254). The
+TPU-side half of that overlap is staging the NEXT batch into device memory
+while the current step runs — jax dispatch is async, so simply keeping a
+small queue of already-device_put batches ahead of the consumer hides the
+host->HBM transfer entirely.
+"""
+
+from collections import deque
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+
+__all__ = ["prefetch_to_device"]
+
+
+def prefetch_to_device(batches: Iterable, size: int = 2,
+                       stage: Optional[Callable[[Any], Any]] = None
+                       ) -> Iterator:
+    """Yield batches with `size` of them already staged ahead on device.
+
+    Args:
+      batches: iterable of pytrees (numpy or jax arrays).
+      size: how many batches to keep in flight (2 = classic double buffer).
+      stage: optional per-batch staging function — e.g.
+        ``lambda b: stage_dp_batch(mesh, b)`` for multi-process sharded
+        inputs, or a `jax.device_put` with a NamedSharding. Defaults to
+        `jax.device_put` (committed default-device placement).
+
+    Yields the staged pytrees in order.
+    """
+    stage = stage or jax.device_put
+    queue: deque = deque()
+    it = iter(batches)
+    try:
+        while len(queue) < size:
+            queue.append(stage(next(it)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(stage(next(it)))
+        except StopIteration:
+            pass
+        yield out

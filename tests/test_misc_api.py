@@ -132,3 +132,17 @@ def test_set_weights_error_paths():
     with pytest.raises(ValueError, match="shape"):
         dist.set_weights([np.zeros((10, 4), np.float32),
                           np.zeros((21, 4), np.float32)])
+
+
+def test_prefetch_to_device_order_and_content():
+    from distributed_embeddings_tpu.utils.prefetch import prefetch_to_device
+
+    batches = [{"x": np.full((2, 2), i, np.float32)} for i in range(5)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        assert isinstance(b["x"], jax.Array)
+        np.testing.assert_allclose(np.asarray(b["x"]), i)
+    # fewer batches than queue depth
+    out = list(prefetch_to_device(iter(batches[:1]), size=3))
+    assert len(out) == 1
