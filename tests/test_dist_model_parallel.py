@@ -33,6 +33,14 @@ def ref_apply(weights, inputs, table_map, combiners):
         x = inputs[i]
         if isinstance(x, RaggedIds):
             out = embedding_lookup(weights[t], x, combiners[t])
+        elif isinstance(x, tuple) and len(x) == 2:
+            ids, w = x
+            emb = jnp.take(weights[t], jnp.asarray(ids), axis=0)
+            w = jnp.asarray(w).astype(emb.dtype)
+            out = jnp.einsum("bk,bkw->bw", w, emb)
+            if combiners[t] == "mean":
+                denom = jnp.maximum(jnp.sum(w, axis=-1), 1.0)
+                out = out / denom[:, None]
         else:
             x = jnp.asarray(x)
             if x.ndim == 1:

@@ -49,3 +49,44 @@ def test_random_config_equivalence(seed):
         if "Not enough tables" in str(e):
             pytest.skip(f"seed {seed}: config unplaceable on 8 devices")
         raise
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(4))
+def test_random_config_ragged_and_weighted(seed):
+    """Same sweep but inputs arrive as RaggedIds / (ids, weights) tuples
+    for combiner tables — the other two prepared-input forms."""
+    import jax.numpy as jnp
+    from distributed_embeddings_tpu.ops.embedding_ops import RaggedIds
+    from test_dist_model_parallel import BATCH
+
+    specs, table_map, kw = gen_config(seed)
+    rng = np.random.RandomState(2000 + seed)
+    inputs, max_hot = [], []
+    for i, t in enumerate(table_map):
+        v, _, c = specs[t]
+        if c is None:
+            inputs.append(jnp.asarray(rng.randint(0, v, size=(BATCH,))))
+            max_hot.append(1)
+        elif rng.rand() < 0.5:
+            k = int(rng.randint(2, 6))
+            lengths = rng.randint(1, k + 1, size=BATCH)
+            values = rng.randint(0, v, size=int(lengths.sum()))
+            splits = np.cumsum([0] + list(lengths))
+            inputs.append(RaggedIds(jnp.asarray(values.astype(np.int32)),
+                                    jnp.asarray(splits.astype(np.int32))))
+            max_hot.append(k)
+        else:
+            k = int(rng.randint(2, 5))
+            ids = rng.randint(0, v, size=(BATCH, k))
+            w = (rng.rand(BATCH, k) > 0.3).astype(np.float32)
+            inputs.append((jnp.asarray(ids), jnp.asarray(w)))
+            max_hot.append(k)
+    try:
+        check_equivalence(specs, input_table_map=table_map, inputs=inputs,
+                          input_max_hotness=max_hot, seed=seed,
+                          check_train=(seed == 0), **kw)
+    except ValueError as e:
+        if "Not enough tables" in str(e):
+            pytest.skip(f"seed {seed}: config unplaceable on 8 devices")
+        raise
