@@ -16,7 +16,7 @@ from distributed_embeddings_tpu.layers.embedding import Embedding
 from distributed_embeddings_tpu.layers.dist_model_parallel import (
     DistributedEmbedding)
 from distributed_embeddings_tpu.ops.embedding_ops import (
-    RaggedIds, embedding_lookup)
+    RaggedIds, SparseIds, embedding_lookup)
 from distributed_embeddings_tpu.parallel.mesh import create_mesh
 
 BATCH = 16
@@ -31,7 +31,7 @@ def ref_apply(weights, inputs, table_map, combiners):
     outs = []
     for i, t in enumerate(table_map):
         x = inputs[i]
-        if isinstance(x, RaggedIds):
+        if isinstance(x, (RaggedIds, SparseIds)):
             out = embedding_lookup(weights[t], x, combiners[t])
         elif isinstance(x, tuple) and len(x) == 2:
             ids, w = x

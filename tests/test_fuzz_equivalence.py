@@ -90,3 +90,35 @@ def test_random_config_ragged_and_weighted(seed):
         if "Not enough tables" in str(e):
             pytest.skip(f"seed {seed}: config unplaceable on 8 devices")
         raise
+
+
+@pytest.mark.slow
+def test_sparse_ids_through_distributed_forward():
+    """COO SparseIds inputs through the full distributed forward — the one
+    prepared-input form the named tests don't cover (reference sparse-input
+    path, embedding_lookup_ops.py:90-96)."""
+    import jax.numpy as jnp
+    from distributed_embeddings_tpu.ops.embedding_ops import SparseIds
+    from test_dist_model_parallel import BATCH
+
+    specs = [(300, 8, "sum"), (500, 8, "mean"), (120, 8, "sum"),
+             (800, 8, "sum"), (256, 8, "mean"), (640, 8, "sum"),
+             (90, 8, "sum"), (410, 8, "sum")]
+    rng = np.random.RandomState(11)
+    inputs, max_hot = [], []
+    for v, _, _ in specs:
+        k = int(rng.randint(2, 5))
+        rows, cols, vals = [], [], []
+        for b in range(BATCH):
+            nnz = int(rng.randint(1, k + 1))
+            for j in range(nnz):
+                rows.append(b)
+                cols.append(j)
+                vals.append(int(rng.randint(0, v)))
+        idx = np.stack([rows, cols], axis=1).astype(np.int32)
+        inputs.append(SparseIds(jnp.asarray(idx),
+                                jnp.asarray(np.asarray(vals, np.int32)),
+                                (BATCH, k)))
+        max_hot.append(k)
+    check_equivalence(specs, inputs=inputs, input_max_hotness=max_hot,
+                      strategy="memory_balanced", check_train=False)
