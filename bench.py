@@ -134,6 +134,15 @@ def run_at_batch(model, batch, iters=10, optimizer="adagrad"):
 
     params, opt_state, losses = run_steps(params, opt_state, batches, iters)
     jax.block_until_ready(losses)
+    profile_dir = os.environ.get("DET_BENCH_PROFILE")
+    if profile_dir:
+        from distributed_embeddings_tpu.utils import profiling
+        with profiling.trace(profile_dir):
+            # rebind: donated params/opt_state are consumed by the call
+            params, opt_state, losses = run_steps(params, opt_state,
+                                                  batches, iters)
+            jax.block_until_ready(losses)
+        print(f"profiler trace written to {profile_dir}", file=sys.stderr)
     t0 = time.perf_counter()
     params, opt_state, losses = run_steps(params, opt_state, batches, iters)
     jax.block_until_ready(losses)
