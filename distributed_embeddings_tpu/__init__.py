@@ -24,6 +24,12 @@ from distributed_embeddings_tpu.layers import dist_model_parallel
 from distributed_embeddings_tpu.layers.dist_model_parallel import (
     DistEmbeddingStrategy,
     DistributedEmbedding,
+    broadcast_variables,
+)
+from distributed_embeddings_tpu.training import (
+    BroadcastGlobalVariablesCallback,
+    DistributedGradientTape,
+    DistributedOptimizer,
 )
 
 __all__ = [
@@ -37,4 +43,8 @@ __all__ = [
     "dist_model_parallel",
     "DistEmbeddingStrategy",
     "DistributedEmbedding",
+    "broadcast_variables",
+    "DistributedGradientTape",
+    "DistributedOptimizer",
+    "BroadcastGlobalVariablesCallback",
 ]

@@ -146,3 +146,16 @@ def test_prefetch_to_device_order_and_content():
     # fewer batches than queue depth
     out = list(prefetch_to_device(iter(batches[:1]), size=3))
     assert len(out) == 1
+
+
+def test_top_level_api_matches_reference():
+    """Every name the reference exports at package top level
+    (reference distributed_embeddings/__init__.py:17-27) must exist here."""
+    import distributed_embeddings_tpu as d
+
+    for name in ["embedding_lookup", "Embedding", "IntegerLookup",
+                 "dist_model_parallel", "DistEmbeddingStrategy",
+                 "DistributedEmbedding", "broadcast_variables",
+                 "DistributedGradientTape", "DistributedOptimizer",
+                 "BroadcastGlobalVariablesCallback", "__version__"]:
+        assert hasattr(d, name), name
