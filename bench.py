@@ -275,7 +275,25 @@ def supervise() -> int:
         if i + 1 < attempts:
             time.sleep(backoff_s)
     print(f"bench failed after {attempts} attempts: {last}", file=sys.stderr)
+    print(_outage_evidence(), file=sys.stderr, flush=True)
     return 1
+
+
+def _outage_evidence() -> str:
+    """Summarize the background claim watcher's probe history (if present)
+    so a failed BENCH artifact documents the outage, not just the symptom."""
+    try:
+        with open("/tmp/claim_watch.log") as f:
+            lines = [ln.strip() for ln in f
+                     if "attempt" in ln or "claim OK" in ln]
+    except OSError:
+        return "(no claim-watcher history available)"
+    if not lines:
+        return "(claim-watcher history empty)"
+    fails = sum("failed" in ln for ln in lines)
+    return (f"claim-watcher history: {fails} failed probes, "
+            f"first={lines[0]!r} last={lines[-1]!r} — TPU tunnel claim "
+            "wedged (jax.devices() hangs; see docs/round2_notes.md)")
 
 
 def main():
