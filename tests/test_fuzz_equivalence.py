@@ -35,6 +35,9 @@ def gen_config(seed):
         kw["column_slice_threshold"] = int(rng.choice([2000, 8000]))
     if rng.rand() < 0.5:
         kw["row_slice_threshold"] = int(rng.choice([8000, 40000]))
+    if rng.rand() < 0.3:
+        # host-offload the biggest buckets (pinned_host on the CPU backend)
+        kw["gpu_embedding_size"] = int(rng.choice([3000, 12000]))
     return specs, table_map, kw
 
 
