@@ -164,6 +164,20 @@ def main(argv=None):
                                                   donate=False)
         opt_state = init_fn(params)
 
+    # resume: restore params + optimizer state from the newest step under
+    # --checkpoint_dir (orbax keeps the saved shardings; same-topology
+    # resume, reference-parity mechanism is save/load_global_weights)
+    start_step = 0
+    if args.checkpoint_dir:
+        last = ckpt_lib.latest_step(args.checkpoint_dir)
+        if last is not None:
+            restored = ckpt_lib.restore_checkpoint(
+                args.checkpoint_dir,
+                {"params": params, "opt_state": opt_state}, step=last)
+            params, opt_state = restored["params"], restored["opt_state"]
+            start_step = last
+            print(f"resumed from step {last}", flush=True)
+
     def get_batch(i):
         numerical, cats, labels = train_data[i % len(train_data)]
         return (jnp.asarray(numerical),
@@ -231,9 +245,9 @@ def main(argv=None):
         out = ckpt_lib.save_global_weights(args.save_weights, weights)
         print(f"saved global embedding weights to {out}", flush=True)
     if args.checkpoint_dir:
-        out = ckpt_lib.save_checkpoint(args.checkpoint_dir,
-                                       {"params": params}, step=steps,
-                                       force=True)
+        out = ckpt_lib.save_checkpoint(
+            args.checkpoint_dir, {"params": params, "opt_state": opt_state},
+            step=start_step + steps, force=True)
         print(f"saved checkpoint to {out}", flush=True)
 
 
