@@ -184,6 +184,8 @@ def dlrm_roofline_bytes_flops(table_widths, hotness, mlp_dims, dtype_bytes=4):
 
 
 def run_dlrm_bench(batches=(65536, 32768, 16384), iters=20):
+    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
+        batches, iters = (256,), 4
     """Single-chip DLRM at Criteo-Kaggle scale (26 x 100k x 128 one-hot
     tables — the 'criteo' synthetic config): samples/sec + roofline estimate.
     Reference 8xA100 Criteo-1TB: 9.16M samples/s TF32 => 1.14M/GPU
@@ -297,7 +299,13 @@ def _outage_evidence() -> str:
 
 
 def main():
-    devices = _init_backend_with_retry()
+    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
+        # plumbing validation without a chip: tiny batches, cpu platform
+        # (sitecustomize pre-selects the TPU plugin, so force post-import)
+        jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
+    else:
+        devices = _init_backend_with_retry()
     print(f"backend: {devices[0].platform} x{len(devices)} "
           f"({devices[0].device_kind})", file=sys.stderr, flush=True)
 
@@ -305,7 +313,10 @@ def main():
     model = SyntheticModel(cfg, mesh=None, distributed=True)
     # the reference chip (A100) has 80G; fall back by batch until we fit
     last_err = None
-    for batch in (65536, 32768, 16384, 8192):
+    batch_ladder = (65536, 32768, 16384, 8192)
+    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
+        batch_ladder = (256,)
+    for batch in batch_ladder:
         try:
             dt = run_at_batch(model, batch)
         except Exception as e:  # noqa: BLE001
