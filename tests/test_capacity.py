@@ -28,3 +28,17 @@ def test_per_chip_shrinks_with_world():
 def test_sgd_has_no_state():
     acct = per_chip_bytes("tiny", 8, 65536, optimizer="sgd")
     assert acct["opt_state"] == 0
+
+
+def test_colossal_planning_completes():
+    """The planner must handle the 2002-table colossal config (22.3 TiB)
+    at pod scale: every table placed, every rank non-empty."""
+    import time
+    t0 = time.perf_counter()
+    acct = per_chip_bytes("colossal", 128, 65536)
+    dt = time.perf_counter() - t0
+    # 22.3 TiB / 128 chips ≈ 178 GiB fair share; padding-inclusive
+    # accounting must land within 3x of that
+    per_chip = acct["tables"] / 2**30
+    assert 100 < per_chip < 600, per_chip
+    assert dt < 120, f"planning took {dt:.0f}s"
