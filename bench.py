@@ -221,6 +221,9 @@ def run_dlrm_bench(batches=(65536, 32768, 16384), iters=20):
             "dlrm_samples_per_sec": round(batch / dt),
             "dlrm_roofline_step_ms": round(bound_s * 1e3, 3),
             "dlrm_roofline_frac": round(bound_s / dt, 3),
+            # reference DLRM: 9.16M samples/s on 8xA100 TF32 => 1.145M/GPU
+            # (examples/dlrm/README.md:7); per-chip normalized comparison
+            "dlrm_vs_ref_per_chip": round(batch / dt / 1_144_734, 3),
         }
     return {"dlrm_error": last_err or "all batches failed"}
 
