@@ -162,7 +162,9 @@ class DistributedEmbedding:
     Args (mirroring the reference :712-751):
       embeddings: list of `Embedding` layer objects (or anything exposing
         `get_config()` with input_dim/output_dim/combiner).
-      strategy: 'basic' | 'memory_balanced' | 'memory_optimized'.
+      strategy: 'basic' | 'memory_balanced' | 'memory_optimized' |
+        'comm_balanced' (beyond-reference: minimizes exchange-group padding
+        volume using `input_max_hotness` hints; memory as tie-break).
       column_slice_threshold: tables above this element count are split along
         output_dim into power-of-2 slices. None = auto only when there are
         fewer tables than devices.
@@ -221,7 +223,8 @@ class DistributedEmbedding:
             column_slice_threshold=column_slice_threshold,
             row_slice_threshold=row_thr,
             data_parallel_threshold=dp_thr,
-            gpu_embedding_size=gpu_embedding_size)
+            gpu_embedding_size=gpu_embedding_size,
+            input_hotness=input_max_hotness)
 
         if self.strategy.table_groups[1]:
             if not all(self.strategy.local_configs):

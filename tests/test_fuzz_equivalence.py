@@ -12,7 +12,8 @@ import pytest
 
 from test_dist_model_parallel import check_equivalence
 
-STRATEGIES = ["basic", "memory_balanced", "memory_optimized"]
+STRATEGIES = ["basic", "memory_balanced", "memory_optimized",
+              "comm_balanced"]
 
 
 def gen_config(seed):
@@ -28,7 +29,7 @@ def gen_config(seed):
     table_map = list(range(n))
     if n >= 4 and rng.rand() < 0.5:
         table_map.append(int(rng.randint(n)))
-    kw = {"strategy": STRATEGIES[rng.randint(3)]}
+    kw = {"strategy": STRATEGIES[rng.randint(len(STRATEGIES))]}
     if rng.rand() < 0.5:
         kw["data_parallel_threshold"] = int(rng.choice([64, 400]))
     if rng.rand() < 0.5:
@@ -125,3 +126,28 @@ def test_sparse_ids_through_distributed_forward():
         max_hot.append(k)
     check_equivalence(specs, inputs=inputs, input_max_hotness=max_hot,
                       strategy="memory_balanced", check_train=False)
+
+
+@pytest.mark.slow
+def test_comm_balanced_equivalence():
+    """comm_balanced placement is numerically identical to the reference
+    model, hotness hints and all (mixed one-hot + multi-hot + shared)."""
+    specs = [(96, 8, "sum"), (50, 8), (300, 8, "sum"), (80, 8, "mean"),
+             (120, 8), (700, 8, "sum"), (60, 8), (210, 8, "sum")]
+    table_map = list(range(8)) + [0, 2]
+    hot = []
+    rng = np.random.RandomState(5)
+    import jax.numpy as jnp
+    inputs = []
+    for i, t in enumerate(table_map):
+        v = specs[t][0]
+        c = specs[t][2] if len(specs[t]) > 2 else None
+        if c is None:
+            inputs.append(jnp.asarray(rng.randint(0, v, size=(16,))))
+            hot.append(1)
+        else:
+            k = 2 + (i % 4)
+            inputs.append(jnp.asarray(rng.randint(0, v, size=(16, k))))
+            hot.append(k)
+    check_equivalence(specs, input_table_map=table_map, inputs=inputs,
+                      input_max_hotness=hot, strategy="comm_balanced")

@@ -195,3 +195,51 @@ def test_offload_tables_not_fused_with_resident():
     plan = lower_strategy(s)
     offloads = {b.offload for b in plan.tp_buckets}
     assert offloads == {True, False}
+
+
+def test_comm_balanced_placement_complete():
+    from distributed_embeddings_tpu.layers.embedding import Embedding
+    specs = [(96, 8), (50, 8), (100, 16), (120, 8), (40, 16), (70, 8),
+             (60, 8), (81, 8), (44, 8)]
+    s = DistEmbeddingStrategy([Embedding(v, w) for v, w in specs], 8,
+                              "comm_balanced",
+                              input_hotness=[1, 5, 1, 5, 1, 1, 5, 1, 1])
+    placed = sorted(t for ids in s.table_ids for t in ids)
+    assert placed == list(range(9))
+    assert all(s.local_configs[r] for r in range(8))
+
+
+def test_comm_balanced_reduces_exchange_volume():
+    """On the synthetic 'small' config at 8 ranks the comm_balanced
+    strategy exchanges strictly less padded volume than memory_balanced
+    (measured 1.47x vs 2.64x of ideal). Pure planning — no arrays built."""
+    from distributed_embeddings_tpu.layers.embedding import Embedding
+    from distributed_embeddings_tpu.models.synthetic import (
+        SYNTHETIC_MODELS, expand_embedding_configs)
+    from distributed_embeddings_tpu.parallel.plan import lower_strategy
+
+    world = 8
+    specs, tmap, hot = expand_embedding_configs(SYNTHETIC_MODELS["small"])
+    total = sum(v * w for v, w in specs)
+
+    def volume(strategy):
+        s = DistEmbeddingStrategy(
+            [Embedding(v, w, combiner="sum") for v, w in specs],
+            world, strategy, input_table_map=tmap,
+            column_slice_threshold=total // world, input_hotness=hot)
+        plan = lower_strategy(s)
+        k_of_tp = {pos: hot[s.input_groups[1][pos]]
+                   for pos in range(len(s.input_groups[1]))}
+        vol = 0
+        for bucket in plan.tp_buckets:
+            per_k = {}
+            for r, slots in enumerate(bucket.slots):
+                for sl in slots:
+                    per_k.setdefault(k_of_tp[sl.tp_input],
+                                     [0] * world)[r] += 1
+            vol += sum(world * max(counts) * k
+                       for k, counts in per_k.items())
+        return vol
+
+    v_mem, v_comm = volume("memory_balanced"), volume("comm_balanced")
+    assert v_comm < v_mem, (v_comm, v_mem)
