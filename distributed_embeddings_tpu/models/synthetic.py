@@ -227,6 +227,9 @@ class SyntheticModel:
             Embedding(rows, width, combiner="sum") for rows, width in tables
         ]
         if distributed:
+            # hotness hints serve the comm_balanced strategy AND allow
+            # ragged inputs; harmless otherwise
+            dist_kwargs.setdefault("input_max_hotness", list(self.hotness))
             self.embedding = DistributedEmbedding(
                 self.embedding_layers, strategy=strategy,
                 input_table_map=table_map,
