@@ -70,3 +70,12 @@ def test_two_process_matches_single_process(tmp_path):
                   ckpt=str(tmp_path / "ck1"))
     assert "ckpt_fwd" in multi  # the distributed-checkpoint phase ran
     assert multi == single, (multi, single)
+
+
+@pytest.mark.slow
+def test_four_process_matches_single_process(tmp_path):
+    """Same worker over 4 gloo processes x 2 local devices — a different
+    process/device factorization of the same 8-device mesh."""
+    multi = _run(4, 2, str(tmp_path / "mp4.json"))
+    single = _run(1, 8, str(tmp_path / "mp1b.json"))
+    assert multi == single, (multi, single)
