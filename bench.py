@@ -184,12 +184,12 @@ def dlrm_roofline_bytes_flops(table_widths, hotness, mlp_dims, dtype_bytes=4):
 
 
 def run_dlrm_bench(batches=(65536, 32768, 16384), iters=20):
-    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
-        batches, iters = (256,), 4
     """Single-chip DLRM at Criteo-Kaggle scale (26 x 100k x 128 one-hot
     tables — the 'criteo' synthetic config): samples/sec + roofline estimate.
     Reference 8xA100 Criteo-1TB: 9.16M samples/s TF32 => 1.14M/GPU
     (examples/dlrm/README.md:7)."""
+    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
+        batches, iters = (256,), 4
     cfg = SYNTHETIC_MODELS["criteo"]
     model = SyntheticModel(cfg, mesh=None, distributed=True)
     last_err = None

@@ -162,9 +162,13 @@ class DistributedEmbedding:
     Args (mirroring the reference :712-751):
       embeddings: list of `Embedding` layer objects (or anything exposing
         `get_config()` with input_dim/output_dim/combiner).
-      strategy: 'basic' | 'memory_balanced' | 'memory_optimized' |
-        'comm_balanced' (beyond-reference: minimizes exchange-group padding
-        volume using `input_max_hotness` hints; memory as tie-break).
+      strategy: 'auto' (default) | 'basic' | 'memory_balanced' |
+        'memory_optimized' | 'comm_balanced' (beyond-reference: minimizes
+        exchange-group padding volume using `input_max_hotness` hints;
+        memory as tie-break). 'auto' = comm_balanced when any
+        input_max_hotness hint > 1 (multi-hot models pay real exchange
+        padding), else the reference's 'basic'. See
+        `exchange_padding_report` for the volume accounting.
       column_slice_threshold: tables above this element count are split along
         output_dim into power-of-2 slices. None = auto only when there are
         fewer tables than devices.
@@ -185,7 +189,7 @@ class DistributedEmbedding:
 
     def __init__(self,
                  embeddings: Sequence,
-                 strategy: str = "basic",
+                 strategy: str = "auto",
                  column_slice_threshold: Optional[int] = None,
                  row_slice_threshold: Optional[int] = None,
                  dp_input: bool = True,
@@ -501,6 +505,51 @@ class DistributedEmbedding:
         ]
         self._groups_cache[key] = res = (groups, assembly)
         return res
+
+    def exchange_padding_report(self, hotness=None) -> dict:
+        """Static accounting of the dp->mp id-exchange volume.
+
+        The exchange sends one dense [world, f_max, k] id block per
+        (bucket, hotness) group and sample (see `_exchange_groups_for_key`)
+        where the reference's `hvd.alltoall` with per-destination splits
+        (reference dist_model_parallel.py:169-288) sends exactly the true
+        nnz. This report quantifies the gap for this plan, per sample:
+
+          true_ids       sum over groups of sum_r f_r * k  (the reference's
+                         splits volume)
+          exchanged_ids  sum over groups of world * f_max * k (what the
+                         fixed-shape lax.all_to_all moves)
+          ratio          exchanged / true  (1.0 = zero padding)
+
+        Args:
+          hotness: per-tp-input hotness override; defaults to the layer's
+            input_max_hotness hints (unhinted inputs count as 1).
+        Returns {"groups": [...], "true_ids", "exchanged_ids", "ratio"}.
+        """
+        tp_inputs = self.strategy.input_groups[1]
+        if hotness is None:
+            mh = self.input_max_hotness or [None] * self._n_inputs
+            hotness = [mh[i] or 1 for i in tp_inputs]
+        if len(hotness) != len(tp_inputs):
+            raise ValueError(
+                f"hotness has {len(hotness)} entries, expected "
+                f"{len(tp_inputs)} (one per tp input)")
+        key = tuple((int(h), False) for h in hotness)
+        groups, _ = self._exchange_groups_for_key(key)
+        report, true_tot, ex_tot = [], 0, 0
+        for g in groups:
+            true_ids = sum(len(s) for s in g.rank_slots) * g.k
+            ex_ids = self.world_size * g.f_max * g.k
+            true_tot += true_ids
+            ex_tot += ex_ids
+            report.append({
+                "bucket": g.bucket, "hotness": g.k, "f_max": g.f_max,
+                "features_per_rank": [len(s) for s in g.rank_slots],
+                "true_ids": true_ids, "exchanged_ids": ex_ids,
+            })
+        return {"groups": report, "true_ids": true_tot,
+                "exchanged_ids": ex_tot,
+                "ratio": (ex_tot / true_tot) if true_tot else 1.0}
 
     def _group_lookup(self, table: jax.Array, ids: jax.Array,
                       weights: Optional[jax.Array],
@@ -1462,7 +1511,6 @@ class DistributedEmbedding:
         (embedding_lookup_kernels.cu:603-775): no [V, w] dense gradient, no
         full-table optimizer pass.
         """
-        groups, _ = self._exchange_groups_for_key(residuals.key)
         n_buckets = len(self.plan.tp_buckets)
         off_buckets = [b for b in range(n_buckets)
                        if self._bucket_memory_kind(b)]
@@ -1470,8 +1518,9 @@ class DistributedEmbedding:
         if off_buckets and opt.kind not in sparse_update_ops.HOST_SPARSE_APPLY:
             raise NotImplementedError(
                 f"sparse optimizer {opt.kind!r} has no host-memory apply "
-                "rule for offloaded buckets (additive rules only: "
+                "rule for offloaded buckets (available: "
                 f"{sorted(sparse_update_ops.HOST_SPARSE_APPLY)})")
+        groups, _ = self._exchange_groups_for_key(residuals.key)
         tp_dev = [params["tp"][b] for b in dev_buckets]
         tp_dev_s = [opt_states["tp"][b] for b in dev_buckets]
 
@@ -1535,7 +1584,7 @@ class DistributedEmbedding:
             lambda i, c: sparse_update_ops.prepare_safe_grad(i, c, rows))(
                 grad.ids, grad.contribs)
 
-    def host_bucket_apply(self, b, table_h, state_h, rep, sums,
+    def host_bucket_apply(self, b, table_h, state_h, rep, sums, valid,
                           opt: SparseOptimizer, lr_value=None):
         """Apply deduped rows to an offloaded bucket's host-resident table.
 
@@ -1549,8 +1598,8 @@ class DistributedEmbedding:
         """
         apply_fn = sparse_update_ops.HOST_SPARSE_APPLY[opt.kind]
         hp = dict(opt.hp)
-        kw = {"eps": hp["eps"]} if (opt.kind == "adagrad"
-                                    and "eps" in hp) else {}
+        kw = {k: hp[k] for k in ("eps", "b1", "b2")
+              if k in hp and opt.kind in ("adagrad", "adam")}
         if self.mesh is not None:
             host_sh = NamedSharding(self.mesh, P(self.axis),
                                     memory_kind="pinned_host")
@@ -1560,9 +1609,14 @@ class DistributedEmbedding:
             host_sh = jax.sharding.SingleDeviceSharding(
                 dev0, memory_kind="pinned_host")
             dev_sh = jax.sharding.SingleDeviceSharding(dev0)
+        # per-world-shard state leaves map over axis 0; global scalars
+        # (adam's step count) are shared across shards and stay unmapped
+        state_axes = jax.tree.map(
+            lambda x: 0 if getattr(x, "ndim", 0) >= 1 else None, state_h)
         vapply = jax.vmap(
-            lambda t, s, r, sm, l: apply_fn(t, s, r, sm, l, **kw),
-            in_axes=(0, 0, 0, 0, None))
+            lambda t, s, r, sm, v, l: apply_fn(t, s, r, sm, v, l, **kw),
+            in_axes=(0, state_axes, 0, 0, 0, None),
+            out_axes=(0, state_axes))
         lr_in = opt.lr if lr_value is None else lr_value
 
         key = ("host_apply", b, opt.kind, rep.shape, sums.shape,
@@ -1572,24 +1626,37 @@ class DistributedEmbedding:
         if fn is None:
             from jax.experimental import compute_on
 
-            def run_native(table_h, state_h, rep, sums, lr_a):
+            def run_native(table_h, state_h, rep, sums, valid, lr_a):
                 rep_h = jax.device_put(rep, host_sh)
                 sums_h = jax.device_put(sums, host_sh)
+                valid_h = jax.device_put(valid, host_sh)
                 with compute_on.compute_on("device_host"):
-                    return vapply(table_h, state_h, rep_h, sums_h, lr_a)
+                    return vapply(table_h, state_h, rep_h, sums_h, valid_h,
+                                  lr_a)
 
-            out_sh = jax.tree.map(lambda _: host_sh, (table_h, state_h))
+            if self.mesh is not None:
+                scalar_sh = NamedSharding(self.mesh, P())
+            else:
+                scalar_sh = jax.sharding.SingleDeviceSharding(
+                    jax.devices()[0])
+            out_sh = jax.tree.map(
+                lambda x: host_sh if getattr(x, "ndim", 0) >= 1
+                else scalar_sh, (table_h, state_h))
             native = jax.jit(run_native, out_shardings=out_sh)
             roundtrip_core = jax.jit(vapply)
 
-            def run_roundtrip(table_h, state_h, rep, sums, lr_a):
+            def run_roundtrip(table_h, state_h, rep, sums, valid, lr_a):
                 t_dev = jax.device_put(table_h, dev_sh)
                 s_dev = jax.tree.map(
-                    lambda x: jax.device_put(x, dev_sh), state_h)
-                new_t, new_s = roundtrip_core(t_dev, s_dev, rep, sums, lr_a)
+                    lambda x: jax.device_put(
+                        x, dev_sh if x.ndim >= 1 else scalar_sh), state_h)
+                new_t, new_s = roundtrip_core(t_dev, s_dev, rep, sums,
+                                              valid, lr_a)
                 return (jax.device_put(new_t, host_sh),
-                        jax.tree.map(lambda x: jax.device_put(x, host_sh),
-                                     new_s))
+                        jax.tree.map(
+                            lambda x: jax.device_put(
+                                x, host_sh if x.ndim >= 1 else scalar_sh),
+                            new_s))
 
             mode = self._host_fn_cache.get(mode_key)
             if mode == "roundtrip":
@@ -1597,9 +1664,10 @@ class DistributedEmbedding:
             elif mode == "native":
                 fn = native
             else:
-                def probe(table_h, state_h, rep, sums, lr_a):
+                def probe(table_h, state_h, rep, sums, valid, lr_a):
                     try:
-                        out = native(table_h, state_h, rep, sums, lr_a)
+                        out = native(table_h, state_h, rep, sums, valid,
+                                     lr_a)
                         self._host_fn_cache[mode_key] = "native"
                         self._host_fn_cache[key] = native
                         return out
@@ -1607,8 +1675,13 @@ class DistributedEmbedding:
                         # only the known backend gap (SPMD partitioners that
                         # cannot place host-memory outputs) falls back; the
                         # fallback pays a full-bucket device round-trip per
-                        # step, so say so once
-                        if "cannot be replicated" not in str(e):
+                        # step, so say so once. XLA:CPU phrases it two ways
+                        # depending on whether the offending op is an array
+                        # ("cannot be replicated") or a scalar placement
+                        # annotation ("Side-effect HLO must have sharding").
+                        if ("cannot be replicated" not in str(e)
+                                and "Side-effect HLO must have sharding"
+                                not in str(e)):
                             raise
                         import warnings
                         warnings.warn(
@@ -1620,11 +1693,11 @@ class DistributedEmbedding:
                         self._host_fn_cache[mode_key] = "roundtrip"
                         self._host_fn_cache[key] = run_roundtrip
                         return run_roundtrip(table_h, state_h, rep, sums,
-                                             lr_a)
+                                             valid, lr_a)
                 fn = probe
             self._host_fn_cache.setdefault(key, fn)
-        return fn(table_h, state_h, rep, sums, jnp.asarray(lr_in,
-                                                           jnp.float32))
+        return fn(table_h, state_h, rep, sums, valid,
+                  jnp.asarray(lr_in, jnp.float32))
 
     @staticmethod
     def _restore_shape(out, p: _PreparedInput, combiner, width):

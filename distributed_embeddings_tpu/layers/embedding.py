@@ -190,11 +190,23 @@ class IntegerLookup:
             try:
                 from distributed_embeddings_tpu.native import hashmap as native_hashmap
                 backend = native_hashmap.NativeIntegerLookup(self.capacity)
-            except Exception:  # noqa: BLE001 - fall back to numpy backend
+            except Exception as e:  # noqa: BLE001 - fall back to numpy backend
+                import warnings
+                warnings.warn(
+                    "IntegerLookup native backend unavailable "
+                    f"({type(e).__name__}: {e}); falling back to the pure-"
+                    "Python per-key loop — expect orders of magnitude lower "
+                    "keys/sec (host-bound). Set DET_DISABLE_NATIVE=1 to "
+                    "silence.", RuntimeWarning, stacklevel=2)
                 backend = None
         if backend is None:
             backend = _NumpyIntegerLookup(self.capacity)
         self._backend = backend
+
+    @property
+    def native(self) -> bool:
+        """True when the C++ open-addressing backend is active."""
+        return not isinstance(self._backend, _NumpyIntegerLookup)
 
     def __call__(self, inputs):
         arr = np.asarray(inputs, dtype=np.int64)

@@ -223,6 +223,45 @@ def _dma_gather_lookup(table: jax.Array, ids: jax.Array, weights: jax.Array,
 # --------------------------------------------------------------------------
 # dispatch + autodiff
 # --------------------------------------------------------------------------
+# widths validated against the XLA fallback on the compiled backend this
+# process; maps width -> bool (False = hardware mismatch, stay on XLA)
+_NARROW_VALIDATED = {}
+
+
+def _narrow_path_ok(width: int, dtype) -> bool:
+    """One-time per-(width, dtype) compiled-vs-XLA equivalence check for
+    sub-lane rows (the suite only exercises interpret mode, so a TPU
+    lowering bug in sub-lane row tiles would otherwise yield silently wrong
+    embeddings; bf16 tables take a different Mosaic tiling than f32, so
+    dtype is part of the key). Runs eagerly at first trace; a mismatch
+    warns and pins the combination to the XLA fallback for the process."""
+    key = (width, jnp.dtype(dtype).name)
+    if key in _NARROW_VALIDATED:
+        return _NARROW_VALIDATED[key]
+    rng = np.random.RandomState(width)
+    vocab = ONEHOT_MAX_VOCAB + 64
+    table = jnp.asarray(rng.randn(vocab, width), dtype=dtype)
+    # batch 500: exercises the production tile configuration (tile_b
+    # capped at 256) AND the padded final tile (500 % 256 != 0) — a
+    # lowering bug specific to large or partial tiles must not slip past
+    # a toy-shape probe
+    ids = jnp.asarray(rng.randint(0, vocab, (500, 4)).astype(np.int32))
+    w = jnp.asarray(rng.rand(500, 4).astype(np.float32))
+    got = np.asarray(_dma_gather_lookup(table, ids, w, interpret=False))
+    want = np.einsum("bk,bkw->bw", np.asarray(w),
+                     np.asarray(table, np.float32)[np.asarray(ids)])
+    tol = 1e-5 if jnp.dtype(dtype) == jnp.float32 else 1e-2
+    ok = bool(np.allclose(got, want, rtol=tol, atol=tol))
+    if not ok:
+        import warnings
+        warnings.warn(
+            f"DET_PALLAS_NARROW: DMA kernel mismatches XLA gather at "
+            f"width {width} dtype {jnp.dtype(dtype).name} on this "
+            "backend; falling back to XLA")
+    _NARROW_VALIDATED[key] = ok
+    return ok
+
+
 def _fused_impl(params, ids, weights, interpret):
     import os
     vocab, width = params.shape
@@ -232,7 +271,10 @@ def _fused_impl(params, ids, weights, interpret):
     # beats XLA's gather is a hardware question — opt in via env until the
     # prims data answers it
     narrow_ok = os.environ.get("DET_PALLAS_NARROW", "0") == "1"
-    if width % _LANE == 0 or (narrow_ok and width in (8, 16, 32, 64)):
+    use_narrow = (narrow_ok and width in (8, 16, 32, 64)
+                  and (_interpret_default(interpret)
+                       or _narrow_path_ok(width, params.dtype)))
+    if width % _LANE == 0 or use_narrow:
         return _dma_gather_lookup(params, ids, weights, interpret=interpret)
     # XLA fallback: gather + weighted reduce (still fused by XLA)
     embs = jnp.take(params, ids, axis=0)

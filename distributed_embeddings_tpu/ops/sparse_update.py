@@ -197,32 +197,62 @@ def sparse_adam(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
 # ------------------------------------- host-memory (offloaded) row updates
 def prepare_safe_grad(ids: jax.Array, contribs: jax.Array, rows: int):
     """Dedup + make scatter-safe for PROMISE_IN_BOUNDS host scatters: padded
-    segments get id 0 with zero sums (additive identity), so no drop-mode
-    bounds machinery (whose constants are illegal in host regions) is
-    needed. Returns (rep [N] in-bounds, sums [N, w])."""
+    segments get id 0 with zero sums (additive identity for sgd/adagrad),
+    so no drop-mode bounds machinery (whose constants are illegal in host
+    regions) is needed. Returns (rep [N] in-bounds, sums [N, w],
+    valid [N] f32 mask) — non-additive rules (adam's moment decay) must
+    mask with `valid`; padded slots alias row 0."""
     rep, sums = dedup_sum(ids, contribs, sentinel=rows)
     valid = rep < rows
     return (jnp.where(valid, rep, 0),
-            jnp.where(valid[:, None], sums, 0.0))
+            jnp.where(valid[:, None], sums, 0.0),
+            valid.astype(jnp.float32))
 
 
-def host_sparse_sgd(table, state, rep, sums, lr):
-    """Additive row update in host memory (inside compute_on). rep/sums from
-    prepare_safe_grad."""
-    del state
+def host_sparse_sgd(table, state, rep, sums, valid, lr):
+    """Additive row update in host memory (inside compute_on). Args from
+    prepare_safe_grad; `valid` unused — padded slots carry zero sums, the
+    additive identity."""
+    del state, valid
     return scatter_add_rows(table, rep, -lr * sums), ()
 
 
-def host_sparse_adagrad(table, state, rep, sums, lr, eps: float = 1e-7):
+def host_sparse_adagrad(table, state, rep, sums, valid, lr,
+                        eps: float = 1e-10):   # = sparse_adagrad's default
+    del valid                       # zero sums -> zero delta on row 0
     (acc,) = state
     acc = scatter_add_rows(acc, rep, sums * sums)
     acc_rows = take_rows(acc, rep)
-    # padded slots carry zero sums -> zero delta on row 0
     delta = -lr * sums * lax.rsqrt(acc_rows + eps)
     return scatter_add_rows(table, rep, delta), (acc,)
 
 
-HOST_SPARSE_APPLY = {"sgd": host_sparse_sgd, "adagrad": host_sparse_adagrad}
+def host_sparse_adam(table, state, rep, sums, valid, lr, b1: float = 0.9,
+                     b2: float = 0.999, eps: float = 1e-8):
+    """Lazy row-wise adam in host memory, matching `sparse_adam` on touched
+    rows. The moment decay is multiplicative, so it is expressed as a
+    masked additive delta (gather old rows, scatter-add new-minus-old);
+    deduped valid reps are unique, making the scatter-add exact. Masking is
+    arithmetic (multiply by the f32 `valid`) — no select/clamp constants,
+    which XLA's memory-space checker rejects inside host regions."""
+    mu, nu, count = state
+    count = count + 1
+    cf = count.astype(jnp.float32)
+    c1 = 1.0 - lax.pow(jnp.float32(b1), cf)
+    c2 = 1.0 - lax.pow(jnp.float32(b2), cf)
+    v = valid[:, None]
+    mu_rows = take_rows(mu, rep)
+    nu_rows = take_rows(nu, rep)
+    mu_new_rows = b1 * mu_rows + (1.0 - b1) * sums
+    nu_new_rows = b2 * nu_rows + (1.0 - b2) * sums * sums
+    mu = scatter_add_rows(mu, rep, (mu_new_rows - mu_rows) * v)
+    nu = scatter_add_rows(nu, rep, (nu_new_rows - nu_rows) * v)
+    delta = -lr * (mu_new_rows / c1) / (jnp.sqrt(nu_new_rows / c2) + eps) * v
+    return scatter_add_rows(table, rep, delta), (mu, nu, count)
+
+
+HOST_SPARSE_APPLY = {"sgd": host_sparse_sgd, "adagrad": host_sparse_adagrad,
+                     "adam": host_sparse_adam}
 
 
 # ------------------------------------------------- optimizer description

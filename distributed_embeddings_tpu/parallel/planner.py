@@ -53,9 +53,18 @@ class DistEmbeddingStrategy:
                  data_parallel_threshold: Optional[int] = None,
                  gpu_embedding_size: Optional[int] = None,
                  input_hotness: Optional[Sequence[Optional[int]]] = None):
-        if strategy not in ("basic", "memory_balanced", "memory_optimized",
-                            "comm_balanced"):
+        if strategy not in ("auto", "basic", "memory_balanced",
+                            "memory_optimized", "comm_balanced"):
             raise ValueError(f"Unsupported shard strategy {strategy}")
+        if strategy == "auto":
+            # multi-hot models (any hotness hint > 1) pay real exchange
+            # padding — minimize it; one-hot models exchange exactly one id
+            # per feature, so placement only matters for memory -> the
+            # reference's default ('basic', :345)
+            strategy = ("comm_balanced"
+                        if input_hotness is not None
+                        and any((h or 1) > 1 for h in input_hotness)
+                        else "basic")
         # single process: plan degenerates like the reference (:357)
         self.strategy = "basic" if world_size == 1 else strategy
         self.world_size = world_size
@@ -74,6 +83,11 @@ class DistEmbeddingStrategy:
         self.input_table_map = list(input_table_map)
         # optional per-input hotness hints (comm_balanced placement): None
         # entries / no list at all degrade to hotness-1 assumptions
+        if input_hotness is not None and \
+                len(input_hotness) != len(self.input_table_map):
+            raise ValueError(
+                f"input_hotness has {len(input_hotness)} entries but there "
+                f"are {len(self.input_table_map)} inputs")
         self.input_hotness = (list(input_hotness)
                               if input_hotness is not None
                               else [None] * len(self.input_table_map))

@@ -286,11 +286,11 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
         tp = list(new_params["embedding"]["tp"])
         tp_s = list(new_state["emb"]["tp"])
         for b, pend in pending.items():
-            rep, sums = pend[0], pend[1]
-            lr_t = pend[2] if len(pend) > 2 else None
+            rep, sums, valid = pend[0], pend[1], pend[2]
+            lr_t = pend[3] if len(pend) > 3 else None
             tp[b], tp_s[b] = emb.host_bucket_apply(
                 b, params["embedding"]["tp"][b], opt_state["emb"]["tp"][b],
-                rep, sums, sopt, lr_value=lr_t)
+                rep, sums, valid, sopt, lr_value=lr_t)
         new_params = {**new_params,
                       "embedding": {**new_params["embedding"], "tp": tp}}
         new_state = {**new_state, "emb": {**new_state["emb"], "tp": tp_s}}
@@ -302,10 +302,16 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
 def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         lr=0.01, sparse: bool = True, opt_state=None, dense_optimizer=None,
         callbacks=(), eval_data=None, eval_every: int = 0,
-        eval_steps: int = 16, log_every: int = 100, log_fn=print):
+        eval_steps: int = 16, log_every: int = 100, log_fn=print,
+        stage=None, sync_every=None):
     """Minimal training-loop driver — the role the reference fills with
     Keras `model.fit` + `DistributedOptimizer` + callbacks
     (reference dist_model_parallel.py:1270-1326, synthetic main.py:104-114).
+
+    The loop never blocks on the loss between sync points: step dispatch is
+    async, so the host stays ahead of the device the way the reference's
+    graph-mode fit does (loss printed per interval, not materialized per
+    step — reference examples/dlrm/main.py:219-221).
 
     Args:
       model: exposes `.embedding`, `loss_fn(params, numerical, cats, labels,
@@ -318,11 +324,24 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
       sparse: use the sparse tapped path (default) or dense optax grads.
       callbacks: objects with optional `on_train_begin(params)` (e.g.
         BroadcastGlobalVariablesCallback) and/or
-        `on_step(step, params, loss)` hooks.
+        `on_step(step, params, loss)` hooks (loss is a device scalar —
+        call float() in the callback only if you accept a sync).
       eval_data / eval_every / eval_steps: run `evaluate` periodically.
+      stage: per-batch staging function forwarded to prefetch_to_device
+        for iterable `data` (e.g. ``lambda b: stage_dp_batch(mesh, b)``).
+        Default: mesh-aware dp staging when the model has a mesh, plain
+        device_put otherwise. Multi-process numpy iterables require the
+        mesh-aware form — a committed single-device array cannot be
+        resharded onto a non-addressable global mesh.
+      sync_every: block on the loss every N steps. Default: 1 on
+        multi-process runs (keeps per-process collectives in lockstep)
+        and on the CPU backend (XLA:CPU's in-process collectives can
+        deadlock when many steps are dispatched asynchronously), else 0
+        (TPU: never block mid-run).
 
     Returns (params, opt_state, history) — history is a dict of lists
-    ('loss', optionally 'eval_auc').
+    ('loss' as floats, materialized once at the end; optionally
+    'eval_auc').
     """
     if sparse:
         init_fn, step_fn = make_sparse_train_step(
@@ -346,6 +365,10 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         if hasattr(cb, "on_train_begin"):
             params = cb.on_train_begin(params)
 
+    if sync_every is None:
+        sync_every = (1 if (jax.process_count() > 1
+                            or jax.default_backend() == "cpu") else 0)
+
     get_batch = data if callable(data) else None
     if get_batch is None:
         # keep 2 batches staged ahead on device: host->HBM transfers overlap
@@ -353,10 +376,17 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         # role, examples/dlrm/utils.py:231-254)
         from distributed_embeddings_tpu.utils.prefetch import (
             prefetch_to_device)
-        it = prefetch_to_device(data)
+        if stage is None:
+            mesh = getattr(getattr(model, "embedding", None), "mesh", None)
+            if mesh is not None:
+                from distributed_embeddings_tpu.parallel.staging import (
+                    stage_dp_batch)
+                stage = lambda b: stage_dp_batch(mesh, b)  # noqa: E731
+        it = prefetch_to_device(data, stage=stage)
     else:
         it = None
     history = {"loss": []}
+    losses = []                     # device scalars; floats only at the end
     for step in range(steps):
         batch = get_batch(step) if get_batch else next(it)
         numerical, cats, labels = batch
@@ -364,10 +394,11 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
                                           jnp.asarray(numerical),
                                           [jnp.asarray(c) for c in cats],
                                           jnp.asarray(labels))
-        loss = float(loss)          # block: keeps CPU collectives in lockstep
-        history["loss"].append(loss)
+        losses.append(loss)
+        if sync_every and (step + 1) % sync_every == 0:
+            jax.block_until_ready(loss)   # explicit lockstep barrier
         if log_every and step % log_every == 0:
-            log_fn(f"step {step}/{steps}: loss={loss:.5f}")
+            log_fn(f"step {step}/{steps}: loss={float(loss):.5f}")
         for cb in callbacks:
             if hasattr(cb, "on_step"):
                 cb.on_step(step, params, loss)
@@ -376,6 +407,7 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
             auc = evaluate(model, params, eval_data, eval_steps)
             history.setdefault("eval_auc", []).append(auc)
             log_fn(f"step {step}: eval AUC={auc:.5f}")
+    history["loss"] = [float(l) for l in jax.device_get(losses)]
     return params, opt_state, history
 
 

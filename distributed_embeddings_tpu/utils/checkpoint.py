@@ -85,6 +85,24 @@ def restore_checkpoint(path: str, template: Any, step: Optional[int] = None,
     return ckptr.restore(target, abstract)
 
 
+def checkpoint_keys(path: str,
+                    step: Optional[int] = None) -> Optional[List[str]]:
+    """Top-level keys of a saved checkpoint tree, from Orbax metadata
+    (no array reads). Lets callers detect a checkpoint's format — e.g. a
+    params-only save vs {'params', 'opt_state'} — instead of guessing from
+    restore failures. Returns None when the metadata cannot be read
+    (callers must NOT treat that as any particular format)."""
+    target = os.path.abspath(_step_dir(path, step))
+    try:
+        meta = _checkpointer().metadata(target)
+    except Exception:  # noqa: BLE001 - metadata layout varies across orbax
+        return None
+    tree = getattr(getattr(meta, "item_metadata", meta), "tree", None)
+    if not isinstance(tree, dict):
+        return None
+    return sorted(tree)
+
+
 def latest_step(path: str) -> Optional[int]:
     """Largest step_{N} subdirectory under path, or None."""
     if not os.path.isdir(path):

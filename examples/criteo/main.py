@@ -97,6 +97,9 @@ def main(argv=None):
 
     n_cat, n_num = args.num_categorical, args.num_numerical
     lookups = [IntegerLookup(args.max_tokens) for _ in range(n_cat)]
+    print(f"IntegerLookup backend: "
+          f"{'native C++' if lookups[0].native else 'numpy (SLOW fallback)'}",
+          flush=True)
     tables = [Embedding(args.max_tokens + 1, args.embedding_dim)
               for _ in range(n_cat)]
 

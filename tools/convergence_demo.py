@@ -1,0 +1,89 @@
+"""DLRM convergence demo: AUC climbs on a learnable synthetic click stream.
+
+The reference's convergence evidence is AUC 0.80248 on Criteo-1TB
+(reference: examples/dlrm/README.md:7). That dataset is unavailable here, so
+this driver trains a scaled-down DLRM (26 tables, power-law ids) on
+`ClickGenerator`'s planted-structure stream (Bayes AUC ~0.85) over the
+8-virtual-device CPU mesh, using the production sparse tapped path +
+warmup/poly-decay LR schedule, and records the AUC curve as a committed
+artifact (VERDICT r2 item 5).
+
+  python tools/convergence_demo.py --steps 2000 --out docs/convergence_r03.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..")))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache"))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def run(steps=2000, batch=512, eval_every=250, eval_steps=8, lr=0.08,
+        seed=0, log_fn=print):
+    from distributed_embeddings_tpu import training
+    from distributed_embeddings_tpu.models.dlrm import DLRM, make_lr_schedule
+    from distributed_embeddings_tpu.models.synthetic import ClickGenerator
+    from distributed_embeddings_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(jax.devices()[:8])
+    sizes = [100 + 137 * i for i in range(26)]        # varied vocabs
+    model = DLRM(sizes, embedding_dim=16, bottom_mlp_dims=(32, 16),
+                 top_mlp_dims=(64, 32, 1), num_numerical_features=13,
+                 mesh=mesh)
+    gen = ClickGenerator(sizes, 13, batch, alpha=1.05, seed=seed + 1)
+    eval_data = lambda j: gen.batch(1_000_000 + j)    # noqa: E731
+
+    params = model.init(jax.random.PRNGKey(seed))
+    schedule = make_lr_schedule(lr, warmup_steps=max(steps // 20, 1),
+                                decay_start_step=steps // 2,
+                                decay_steps=max(steps // 2, 1))
+    params, _, hist = training.fit(
+        model, params, gen, steps=steps, optimizer="adagrad", lr=schedule,
+        sparse=True, eval_data=eval_data, eval_every=eval_every,
+        eval_steps=eval_steps, log_every=max(eval_every // 2, 1),
+        log_fn=log_fn)
+    return {
+        "model": {"tables": len(sizes), "vocab_total": sum(sizes),
+                  "embedding_dim": 16, "batch": batch, "steps": steps,
+                  "optimizer": "adagrad", "lr": lr, "alpha": 1.05},
+        "loss_first100_mean": float(sum(hist["loss"][:100]) /
+                                    max(len(hist["loss"][:100]), 1)),
+        "loss_last100_mean": float(sum(hist["loss"][-100:]) /
+                                   max(len(hist["loss"][-100:]), 1)),
+        "eval_auc": [round(a, 5) for a in hist.get("eval_auc", [])],
+        "eval_every": eval_every,
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=2000)
+    p.add_argument("--batch", type=int, default=512)
+    p.add_argument("--eval_every", type=int, default=250)
+    p.add_argument("--eval_steps", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.08)
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+    result = run(args.steps, args.batch, args.eval_every, args.eval_steps,
+                 args.lr)
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
