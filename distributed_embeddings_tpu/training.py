@@ -340,8 +340,8 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         (TPU: never block mid-run).
 
     Returns (params, opt_state, history) — history is a dict of lists
-    ('loss' as floats, materialized once at the end; optionally
-    'eval_auc').
+    ('loss' as floats, drained from device at sync/log boundaries;
+    optionally 'eval_auc').
     """
     if sparse:
         init_fn, step_fn = make_sparse_train_step(
@@ -386,7 +386,14 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
     else:
         it = None
     history = {"loss": []}
-    losses = []                     # device scalars; floats only at the end
+    pending = []     # device scalars since the last sync; drained to floats
+    # at sync/log boundaries (where a block happens anyway) so long runs
+    # never hold an unbounded number of live device buffers
+
+    def drain():
+        history["loss"].extend(float(l) for l in jax.device_get(pending))
+        pending.clear()
+
     for step in range(steps):
         batch = get_batch(step) if get_batch else next(it)
         numerical, cats, labels = batch
@@ -394,11 +401,14 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
                                           jnp.asarray(numerical),
                                           [jnp.asarray(c) for c in cats],
                                           jnp.asarray(labels))
-        losses.append(loss)
+        pending.append(loss)
         if sync_every and (step + 1) % sync_every == 0:
-            jax.block_until_ready(loss)   # explicit lockstep barrier
+            drain()                       # explicit lockstep barrier
         if log_every and step % log_every == 0:
-            log_fn(f"step {step}/{steps}: loss={float(loss):.5f}")
+            drain()
+            log_fn(f"step {step}/{steps}: loss={history['loss'][-1]:.5f}")
+        elif len(pending) >= 4096:
+            drain()    # no-sync runs still bound live device buffers
         for cb in callbacks:
             if hasattr(cb, "on_step"):
                 cb.on_step(step, params, loss)
@@ -407,7 +417,7 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
             auc = evaluate(model, params, eval_data, eval_steps)
             history.setdefault("eval_auc", []).append(auc)
             log_fn(f"step {step}: eval AUC={auc:.5f}")
-    history["loss"] = [float(l) for l in jax.device_get(losses)]
+    drain()
     return params, opt_state, history
 
 

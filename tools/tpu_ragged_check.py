@@ -1,0 +1,54 @@
+"""Does this backend lower `lax.ragged_all_to_all`? (single-chip check)
+
+The true-splits exchange (reference dist_model_parallel.py:169-288 —
+`hvd.alltoall` with per-destination `splits` paying exactly nnz) maps to
+`lax.ragged_all_to_all` on TPU. Round 2 deferred it because XLA:CPU has no
+lowering, making it untestable on the virtual mesh (docs/round2_notes.md).
+This stage answers the half that needs only one real chip: does the TPU
+backend compile AND execute the op with correct semantics on a 1-device
+mesh? A pass green-lights building the true-splits exchange behind a flag;
+a fail records the concrete error for the round notes.
+
+Run via tools/tpu_validate.py (stage 'ragged') — own process + timeout.
+"""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def main():
+    d = jax.devices()
+    assert d and d[0].platform != "cpu", f"cpu fallback: {d}"
+    print("devices", d, flush=True)
+    mesh = Mesh(np.array(d[:1]), ("x",))
+    n = 16
+
+    def body(x):
+        out = jnp.full((n,), -1.0, x.dtype)
+        in_off = jnp.array([0], jnp.int32)
+        send = jnp.array([5], jnp.int32)
+        out_off = jnp.array([2], jnp.int32)
+        recv = jnp.array([5], jnp.int32)
+        return lax.ragged_all_to_all(x, out, in_off, send, out_off, recv,
+                                     axis_name="x")
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                              out_specs=P("x")))
+    x = jnp.arange(n, dtype=jnp.float32)
+    t0 = time.perf_counter()
+    got = np.asarray(jax.block_until_ready(f(x)))
+    dt = time.perf_counter() - t0
+    want = np.full((n,), -1.0, np.float32)
+    want[2:7] = np.arange(5, dtype=np.float32)
+    np.testing.assert_array_equal(got, want)
+    print(f"ragged_all_to_all: LOWERS + CORRECT on "
+          f"{d[0].platform} (compile+run {dt:.1f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

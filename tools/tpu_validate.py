@@ -31,6 +31,7 @@ PROBE_SRC = (
     "import time,jax,jax.numpy as jnp;"
     "t0=time.perf_counter();d=jax.devices();"
     "print('devices',d,round(time.perf_counter()-t0,1));"
+    "assert d and d[0].platform != 'cpu', f'cpu fallback: {d}';"
     "t0=time.perf_counter();"
     "jax.block_until_ready(jnp.ones((512,512))@jnp.ones((512,512)));"
     "print('matmul_s',round(time.perf_counter()-t0,1))"
@@ -98,6 +99,7 @@ def main():
         ("probe", [sys.executable, "-u", "-c", PROBE_SRC], 240),
         ("pallas", [sys.executable, "-u", "tools/tpu_pallas_check.py",
                     "--quick"], 1800),
+        ("ragged", [sys.executable, "-u", "tools/tpu_ragged_check.py"], 900),
         ("bench", [sys.executable, "-u", "bench.py"], 3600 * 3),
         ("prims", [sys.executable, "-u", "tools/tpu_primitives_bench.py",
                    "--iters", str(args.iters)], 1800),
