@@ -211,21 +211,30 @@ class IntegerLookup:
     def __call__(self, inputs):
         arr = np.asarray(inputs, dtype=np.int64)
         flat = arr.reshape(-1)
-        # per-batch unique before touching the hash (the reference's CPU
-        # backend does exactly this, embedding.py:246-252): power-law id
-        # streams are duplicate-heavy, so hashing |unique| << N keys wins.
-        # np.unique sorts; reorder by first appearance so insertion ids (and
-        # get_vocabulary order) match the sequential contract.
-        uniq, first_idx, inv = np.unique(flat, return_index=True,
-                                         return_inverse=True)
-        if len(uniq) < len(flat):
-            order = np.argsort(first_idx, kind="stable")
-            out_u = self._backend.lookup_or_insert(uniq[order])
-            rank = np.empty_like(order)
-            rank[order] = np.arange(len(order))
-            out = out_u[rank][inv]
-        else:
+        if self.native:
+            # the native backend probes in parallel (O(n), multi-thread)
+            # and its ordered sequential insert phase keeps first-
+            # appearance id assignment with duplicates in the batch, so
+            # it takes the raw stream — a numpy pre-unique would
+            # serialize everything behind an O(n log n) sort
             out = self._backend.lookup_or_insert(flat)
+        else:
+            # numpy fallback: per-batch unique before the per-key dict
+            # loop (the reference's CPU backend does exactly this,
+            # embedding.py:246-252) — power-law id streams are duplicate-
+            # heavy, so hashing |unique| << N keys wins. np.unique sorts;
+            # reorder by first appearance so insertion ids (and
+            # get_vocabulary order) match the sequential contract.
+            uniq, first_idx, inv = np.unique(flat, return_index=True,
+                                             return_inverse=True)
+            if len(uniq) < len(flat):
+                order = np.argsort(first_idx, kind="stable")
+                out_u = self._backend.lookup_or_insert(uniq[order])
+                rank = np.empty_like(order)
+                rank[order] = np.arange(len(order))
+                out = out_u[rank][inv]
+            else:
+                out = self._backend.lookup_or_insert(flat)
         res = out.reshape(arr.shape)
         if isinstance(inputs, jax.Array):
             return jnp.asarray(res)

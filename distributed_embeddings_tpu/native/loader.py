@@ -16,11 +16,18 @@ def load():
     with _LOCK:
         if _LIB is not None:
             return _LIB
-        if not os.path.exists(_SO):
-            srcs = [os.path.join(_DIR, f) for f in ("hashmap.cpp", "io.cpp")]
+        srcs = [os.path.join(_DIR, f) for f in ("hashmap.cpp", "io.cpp")]
+        stale = (not os.path.exists(_SO)
+                 or any(os.path.getmtime(s) > os.path.getmtime(_SO)
+                        for s in srcs))
+        if stale:
+            # build to a temp name + atomic rename: concurrent processes
+            # (multi-process tests) must never dlopen a half-written .so
+            tmp = f"{_SO}.build.{os.getpid()}"
             cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-Wall",
-                   *srcs, "-o", _SO]
+                   "-pthread", *srcs, "-o", tmp]
             subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp, _SO)
         lib = ctypes.CDLL(_SO)
 
         i64 = ctypes.c_int64

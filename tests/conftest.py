@@ -16,16 +16,20 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 # persistent compilation cache: CPU test compiles of grad-of-shard_map are
 # slow; cache them across pytest runs. Repo-local so it survives reboots
-# (a /tmp cache is lost and the cold suite takes >9.5 min).
+# (a /tmp cache is lost and the cold suite takes ~20 min).
 _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR", os.path.join(_repo_root, ".jax_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                            os.path.join(_repo_root, ".jax_cache"))
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# config.update, NOT env vars: the axon sitecustomize pre-imports jax, so
+# the cache env vars would be read before this file runs and mostly
+# ignored (observed: 11 cache entries after a 20-minute suite)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
 def pytest_configure(config):

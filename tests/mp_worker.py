@@ -42,6 +42,10 @@ def main():
         f"--xla_force_host_platform_device_count={args.local_devices}")
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # config.update, not env: sitecustomize pre-imports jax (see conftest)
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".jax_cache")))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     if args.nproc > 1:
         from distributed_embeddings_tpu.parallel.mesh import (
             initialize_distributed)
@@ -173,6 +177,49 @@ def main():
     mp_outs = dist_mp.apply_mp(mp_params, mp_inputs)
     sums = jax.jit(lambda *os: [jnp.sum(o * o) for o in os])(*mp_outs)
     checks["mp_fwd"] = [round(float(s), 4) for s in sums]
+
+    # fit loop with ITERABLE per-process data: exercises fit's default
+    # mesh-aware staging (stage_dp_batch / make_array_from_process_local_
+    # data) — a committed single-device device_put cannot be resharded
+    # onto a non-addressable global mesh, so this path only works if the
+    # default stage is mesh-aware (round-3 fix), and the sync_every=1
+    # lockstep default keeps the processes' collectives aligned
+    class _FitModel:
+        def __init__(self, emb):
+            self.embedding = emb
+
+        def loss_fn(self, p, numerical, cats, labels, taps=None,
+                    return_residuals=False):
+            del numerical
+            out = self.embedding(p["embedding"], list(cats), taps=taps,
+                                 return_residuals=return_residuals)
+            outs, res = out if return_residuals else (out, None)
+            x = jnp.concatenate(
+                [o.reshape(o.shape[0], -1) for o in outs],
+                axis=1).astype(jnp.float32)
+            loss = jnp.mean((jnp.sum(x, axis=1) - labels.reshape(-1)) ** 2)
+            return (loss, res) if return_residuals else loss
+
+    from distributed_embeddings_tpu import training
+
+    b_local = batch // args.nproc
+    rngf = np.random.RandomState(21)          # same stream on every process
+    fit_batches = []
+    for _ in range(6):
+        cats_g = [rngf.randint(0, v, size=batch).astype(np.int32)
+                  for v, _ in sizes]
+        labs_g = rngf.randn(batch).astype(np.float32)
+        fit_batches.append(
+            (np.zeros((b_local, 1), np.float32),
+             [c[lo:lo + b_local] for c in cats_g],
+             labs_g[lo:lo + b_local]))
+    fit_params, _, fit_hist = training.fit(
+        _FitModel(dist), {"embedding": dist.set_weights(weights)},
+        iter(fit_batches), steps=6, optimizer="adagrad", lr=0.1,
+        sparse=True, log_every=0, log_fn=lambda *_: None)
+    checks["fit_loss"] = [round(l, 5) for l in fit_hist["loss"]]
+    checks["fit_fwd"] = [round(float(s), 4)
+                         for s in fwd(fit_params["embedding"], inputs)]
 
     if args.pid == 0:
         with open(args.out, "w") as f:

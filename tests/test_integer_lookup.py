@@ -102,3 +102,24 @@ def test_disable_env_is_silent(monkeypatch):
         layer = IntegerLookup(max_tokens=10)
     assert not layer.native
     assert not [x for x in w if "pure-Python" in str(x.message)]
+
+
+def test_native_parallel_large_batch_matches_sequential():
+    """The parallel two-phase native path (multi-thread probe + ordered
+    insert) must be indistinguishable from the sequential numpy reference:
+    same indices, same insertion order, duplicate-heavy batches well past
+    the threading threshold (32k keys)."""
+    nat = IntegerLookup(max_tokens=50_000, use_native=True)
+    if not nat.native:
+        import pytest
+        pytest.skip("native backend unavailable")
+    ref = IntegerLookup(max_tokens=50_000, use_native=False)
+    rng = np.random.RandomState(0)
+    for size_hint in (30_000, 45_000):     # growth batch, then mostly-hits
+        keys = rng.randint(0, size_hint, size=200_000).astype(np.int64)
+        np.testing.assert_array_equal(nat(keys), ref(keys))
+    assert nat.get_vocabulary() == ref.get_vocabulary()
+    # overflow batch: indices past capacity must map to OOV identically
+    keys = rng.randint(50_000, 120_000, size=200_000).astype(np.int64)
+    np.testing.assert_array_equal(nat(keys), ref(keys))
+    assert nat.size == ref.size == 50_001

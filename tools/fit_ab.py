@@ -24,14 +24,15 @@ sys.path.insert(0, os.path.abspath(os.path.join(
     os.path.dirname(__file__), "..")))
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), ".jax_cache"))
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# config.update, not env: sitecustomize pre-imports jax (see conftest.py)
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -52,7 +53,6 @@ def main():
                    EmbeddingConfig(2, [4], 5000, 16, False)],
         [64, 32], 4, None)
     model = SyntheticModel(cfg, mesh=None, distributed=True)
-    rng = np.random.RandomState(0)
 
     def batch(step):
         r = np.random.RandomState(step % 8)
