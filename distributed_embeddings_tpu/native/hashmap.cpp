@@ -121,9 +121,11 @@ int64_t il_size(void* handle) {
   return static_cast<IntegerLookupMap*>(handle)->size;
 }
 
-// Two-phase batch insert: phase 1 probes read-only IN PARALLEL (no writer
-// is active, so plain reads of slot_keys/slot_vals are race-free; hit
-// counts use relaxed atomic adds), phase 2 inserts the misses
+// Two-phase batch insert: phase 1 probes read-only IN PARALLEL (callers
+// are serialized per map — the Python wrapper holds a lock across each
+// call, so no writer is ever concurrent with the probe and plain reads of
+// slot_keys/slot_vals are race-free; hit counts use relaxed atomic adds),
+// phase 2 inserts the misses
 // SEQUENTIALLY in batch order — preserving the exact first-appearance
 // id-assignment contract of the sequential map (the property
 // get_vocabulary() and the keras-parity tests pin). After vocabulary

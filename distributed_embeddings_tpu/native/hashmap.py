@@ -13,9 +13,16 @@ class NativeIntegerLookup:
     """
 
     def __init__(self, capacity: int):
+        import threading
         self._lib = loader.load()
         self.capacity = int(capacity)
         self._handle = self._lib.il_create(self.capacity)
+        # ctypes releases the GIL during native calls; the C++ map's
+        # internal probe threads assume no concurrent WRITER (phase-2
+        # insert). Serialize whole calls so multi-threaded data pipelines
+        # sharing one layer stay race-free (intra-call parallelism is
+        # unaffected).
+        self._call_lock = threading.Lock()
 
     def __del__(self):
         try:
@@ -32,15 +39,17 @@ class NativeIntegerLookup:
     def lookup_or_insert(self, keys: np.ndarray) -> np.ndarray:
         keys = np.ascontiguousarray(keys, dtype=np.int64)
         out = np.empty(keys.shape, dtype=np.int64)
-        self._lib.il_lookup_or_insert(
-            self._handle, keys.ctypes.data, keys.size, out.ctypes.data)
+        with self._call_lock:
+            self._lib.il_lookup_or_insert(
+                self._handle, keys.ctypes.data, keys.size, out.ctypes.data)
         return out
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         keys = np.ascontiguousarray(keys, dtype=np.int64)
         out = np.empty(keys.shape, dtype=np.int64)
-        self._lib.il_lookup(
-            self._handle, keys.ctypes.data, keys.size, out.ctypes.data)
+        with self._call_lock:
+            self._lib.il_lookup(
+                self._handle, keys.ctypes.data, keys.size, out.ctypes.data)
         return out
 
     def keys_in_index_order(self):

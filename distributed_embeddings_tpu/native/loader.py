@@ -17,17 +17,30 @@ def load():
         if _LIB is not None:
             return _LIB
         srcs = [os.path.join(_DIR, f) for f in ("hashmap.cpp", "io.cpp")]
-        stale = (not os.path.exists(_SO)
-                 or any(os.path.getmtime(s) > os.path.getmtime(_SO)
-                        for s in srcs))
+        have_so = os.path.exists(_SO)
+        # missing sources (stripped install) are NOT stale — use the .so
+        stale = (not have_so
+                 or (all(os.path.exists(s) for s in srcs)
+                     and any(os.path.getmtime(s) > os.path.getmtime(_SO)
+                             for s in srcs)))
         if stale:
             # build to a temp name + atomic rename: concurrent processes
             # (multi-process tests) must never dlopen a half-written .so
             tmp = f"{_SO}.build.{os.getpid()}"
             cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-Wall",
                    "-pthread", *srcs, "-o", tmp]
-            subprocess.run(cmd, check=True, capture_output=True)
-            os.replace(tmp, _SO)
+            try:
+                subprocess.run(cmd, check=True, capture_output=True)
+                os.replace(tmp, _SO)
+            except Exception:
+                # rebuild of a newer source failed (no g++?): a prebuilt
+                # .so still beats the numpy fallback — warn and use it
+                if not have_so:
+                    raise
+                import warnings
+                warnings.warn(
+                    "native rebuild failed; using the existing (possibly "
+                    "stale) _det_native.so", RuntimeWarning, stacklevel=2)
         lib = ctypes.CDLL(_SO)
 
         i64 = ctypes.c_int64

@@ -53,7 +53,11 @@ def benchmark(fn: Callable, *args, iters: int = 20, warmup: int = 2,
     compile_s = time.perf_counter() - t0
     for _ in range(warmup):
         out = fn(*args, **kwargs)
-    jax.block_until_ready(out)
+        # sync EVERY call: XLA:CPU's in-process collectives deadlock when
+        # several collective-bearing executions are queued concurrently
+        # (rendezvous termination after 40s); on TPU this just serializes
+        # warmup, which is fine
+        jax.block_until_ready(out)
 
     times = []
     for _ in range(iters):
@@ -77,7 +81,7 @@ def benchmark_batches(fn: Callable, batches: Sequence, iters: int = 20,
     compile_s = time.perf_counter() - t0
     for i in range(warmup):
         out = fn(*batches[i % len(batches)])
-    jax.block_until_ready(out)
+        jax.block_until_ready(out)   # see benchmark(): CPU collective safety
 
     times = []
     for i in range(iters):

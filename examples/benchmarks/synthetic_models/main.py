@@ -10,7 +10,13 @@ same optimizer).
   python examples/benchmarks/synthetic_models/main.py --model tiny \
       --batch_size 65536 --optimizer adagrad
   python examples/benchmarks/synthetic_models/main.py --model tiny \
-      --force_cpu --devices 8 --batch_size 1024 --steps 8   # smoke
+      --force_cpu --batch_size 1024 --steps 8 --table_scale 0.01  # smoke
+
+CPU smoke note: pass --table_scale on few-core hosts. XLA:CPU's collective
+rendezvous aborts the process (F-level check, 40s budget) if any virtual
+device's partition cannot reach the all_to_all in time — full-size tables
+on a 1-core container starve it. Scaled tables keep per-device work far
+under the budget; real TPU backends have no such limit.
 """
 
 import os

@@ -61,7 +61,11 @@ class TinyModel:
 
 def run_equivalence(specs, optimizer, input_table_map=None, steps=3,
                     strategy="sort", seed=0, lr=0.05, rtol=5e-5, atol=5e-5,
-                    inputs_fn=None, **dist_kwargs):
+                    inputs_fn=None, placement=None, **dist_kwargs):
+    # `strategy` is the sparse DEDUP strategy; `placement` (if given) is the
+    # planner strategy, forwarded as DistributedEmbedding(strategy=...)
+    if placement is not None:
+        dist_kwargs["strategy"] = placement
     rng = np.random.RandomState(seed)
     mesh = create_mesh(jax.devices()[:8])
     table_map = (list(input_table_map) if input_table_map
