@@ -99,7 +99,8 @@ class _ExchangeGroup:
     computed at trace time from the plan + each input's (static) hotness."""
 
     __slots__ = ("bucket", "k", "class_inputs", "sel", "offs", "f_max",
-                 "need_w", "rank_slots")
+                 "need_w", "rank_slots", "f_per_rank", "flat_sel",
+                 "in_offsets")
 
     def __init__(self, bucket, k, class_inputs, sel, offs, f_max, need_w,
                  rank_slots):
@@ -111,6 +112,15 @@ class _ExchangeGroup:
         self.f_max = f_max
         self.need_w = need_w
         self.rank_slots = rank_slots    # per rank: ordered member TPSlots
+        # true-splits (ragged) exchange metadata: per-destination feature
+        # counts, the unpadded destination-major selector, and each
+        # destination's start row in the flat send buffer
+        self.f_per_rank = np.asarray([len(s) for s in rank_slots], np.int32)
+        self.flat_sel = (np.concatenate(
+            [sel[r, :n] for r, n in enumerate(self.f_per_rank)])
+            if int(self.f_per_rank.sum()) else np.zeros((0,), np.int32))
+        self.in_offsets = np.concatenate(
+            [[0], np.cumsum(self.f_per_rank)[:-1]]).astype(np.int32)
 
 
 class TapResiduals:
@@ -138,6 +148,46 @@ class TapResiduals:
 
 jax.tree_util.register_pytree_node(
     TapResiduals, TapResiduals.tree_flatten, TapResiduals.tree_unflatten)
+
+
+def _ragged_exchange_op(operand, output, in_off, send_sz, out_off, recv_sz,
+                        axis: str, native: bool):
+    """One true-splits all-to-all: sends `send_sz[d]` rows of `operand`
+    (starting at `in_off[d]`) to each device d, landing at `out_off[d]` in
+    d's `output`; `recv_sz[s]` rows arrive from each source s. This is the
+    reference's `hvd.alltoall(x, splits)` contract
+    (dist_model_parallel.py:134, :211): wire bytes are the true nnz, not
+    the padded block.
+
+    native=True lowers to `lax.ragged_all_to_all` (TPU; XLA:CPU has no
+    lowering — see tools/tpu_ragged_check.py). native=False runs a
+    semantics-exact emulation from equal-shaped collectives (all_gather +
+    masked gather) so the FULL exchange path — metadata, layouts,
+    reassembly — is executable and equivalence-tested on the CPU mesh;
+    only the op itself differs, and that op is validated on hardware by
+    the 'ragged' stage of tools/tpu_validate.py.
+    """
+    if native:
+        return lax.ragged_all_to_all(operand, output, in_off, send_sz,
+                                     out_off, recv_sz, axis_name=axis)
+    ops = lax.all_gather(operand, axis)            # [world, S, inner]
+    g_in = lax.all_gather(in_off, axis)            # [world, world]
+    g_send = lax.all_gather(send_sz, axis)
+    g_out = lax.all_gather(out_off, axis)
+    me = lax.axis_index(axis)
+    n_out = output.shape[0]
+    i = jnp.arange(n_out)
+    starts = g_out[:, me]                          # my chunk starts, per src
+    sizes = g_send[:, me]
+    src0 = g_in[:, me]
+    m = ((i[None, :] >= starts[:, None])
+         & (i[None, :] < (starts + sizes)[:, None]))   # [world, n_out]
+    valid = jnp.any(m, axis=0)
+    s_idx = jnp.argmax(m, axis=0)
+    src_row = jnp.clip(src0[s_idx] + i - starts[s_idx], 0,
+                       operand.shape[0] - 1)
+    gathered = ops[s_idx, src_row]
+    return jnp.where(valid[:, None], gathered, output)
 
 
 def _effective_weights(weights: Optional[jax.Array], k: int,
@@ -244,6 +294,17 @@ class DistributedEmbedding:
         # route multi-hot fused-bucket lookups through the Pallas kernels when
         # on a TPU backend; plain XLA gather+reduce otherwise.
         self.use_custom_kernel = use_custom_kernel
+        # DET_RAGGED_EXCHANGE=1: dp->mp ids (and weights, incl. the masks
+        # synthesized for ragged/sparse inputs) move via the true-splits
+        # exchange (_ragged_exchange_op) instead of padded [world, f_max]
+        # blocks — the reference's exact hvd.alltoall(splits) wire volume.
+        # Off by default until hardware perf data exists (the padding is
+        # already bounded by comm_balanced, see exchange_padding_report).
+        # DET_RAGGED_NATIVE overrides the native-vs-emulation choice
+        # (default: native iff TPU backend).
+        import os as _os
+        self._ragged_exchange = (
+            _os.environ.get("DET_RAGGED_EXCHANGE", "0") == "1")
         # mixed precision (reference tests' mixed_precision_policy,
         # dist_model_parallel_test.py:30-34): params stay fp32, the lookup
         # outputs / combines / collectives run in compute_dtype (e.g. bf16).
@@ -651,27 +712,12 @@ class DistributedEmbedding:
         for g, grp in enumerate(groups):
             ids = group_ids[g]                               # [B_l, n_g, k]
             blocal = ids.shape[0]
-            sel = jnp.asarray(grp.sel.reshape(-1))           # [world*f_max]
-            send = jnp.take(ids, sel, axis=1).reshape(
-                blocal, world, grp.f_max, grp.k)
-            send = jnp.moveaxis(send, 1, 0)                  # [world, B_l, f, k]
-            w_x = None
-            if group_w[g] is not None:
-                w_send = jnp.take(group_w[g], sel, axis=1).reshape(
-                    blocal, world, grp.f_max, grp.k)
-                w_send = jnp.moveaxis(w_send, 1, 0)
-            if world > 1:
-                recv = lax.all_to_all(send, self.axis, split_axis=0,
-                                      concat_axis=0)
-                if group_w[g] is not None:
-                    w_recv = lax.all_to_all(w_send, self.axis, split_axis=0,
-                                            concat_axis=0)
-                    w_x = w_recv.reshape(-1, grp.f_max, grp.k)
+            if self._ragged_exchange and world > 1:
+                ids_x, w_x = self._ragged_id_exchange(
+                    grp, ids, group_w[g], world, blocal)
             else:
-                recv = send
-                if group_w[g] is not None:
-                    w_x = w_send.reshape(-1, grp.f_max, grp.k)
-            ids_x = recv.reshape(-1, grp.f_max, grp.k)       # [B, f, k]
+                ids_x, w_x = self._padded_id_exchange(
+                    grp, ids, group_w[g], world, blocal)
             offs = self._device_const(grp.offs)              # [f_max]
             ids_x = ids_x + offs[None, :, None].astype(ids_x.dtype)
             bucket = self.plan.tp_buckets[grp.bucket]
@@ -702,6 +748,69 @@ class DistributedEmbedding:
             None if taps is None else taps["row"], want_res)
         res = ((tp_res_ids, tp_res_w) + row_res) if want_res else None
         return dp_outs, ex_list, row_outs, off_ids, off_w, res
+
+    def _padded_id_exchange(self, grp, ids, w, world, blocal):
+        """Fixed-shape dp->mp id (+weight) exchange: dense
+        [world, B_l, f_max, k] blocks through lax.all_to_all (padding
+        bounded by the comm_balanced placement)."""
+        sel = jnp.asarray(grp.sel.reshape(-1))           # [world*f_max]
+        send = jnp.take(ids, sel, axis=1).reshape(
+            blocal, world, grp.f_max, grp.k)
+        send = jnp.moveaxis(send, 1, 0)                  # [world, B_l, f, k]
+        w_x = None
+        if w is not None:
+            w_send = jnp.take(w, sel, axis=1).reshape(
+                blocal, world, grp.f_max, grp.k)
+            w_send = jnp.moveaxis(w_send, 1, 0)
+        if world > 1:
+            recv = lax.all_to_all(send, self.axis, split_axis=0,
+                                  concat_axis=0)
+            if w is not None:
+                w_recv = lax.all_to_all(w_send, self.axis, split_axis=0,
+                                        concat_axis=0)
+                w_x = w_recv.reshape(-1, grp.f_max, grp.k)
+        else:
+            recv = send
+            if w is not None:
+                w_x = w_send.reshape(-1, grp.f_max, grp.k)
+        return recv.reshape(-1, grp.f_max, grp.k), w_x   # [B, f, k]
+
+    def _ragged_id_exchange(self, grp, ids, w, world, blocal):
+        """True-splits dp->mp exchange (DET_RAGGED_EXCHANGE=1): each
+        destination's features travel unpadded — sum_r f_r rows on the
+        wire instead of world*f_max (the reference's hvd.alltoall(splits)
+        volume, dist_model_parallel.py:169-288). Weights (explicit or the
+        synthesized ragged/sparse masks) ride the same metadata;
+        `lax.ragged_all_to_all` carries jvp+transpose rules, so the weight
+        gradient flows back through the reverse exchange. The receive
+        buffer keeps the [world, f_max] layout (static shapes; unwritten
+        slots read as id/weight 0 and are never consumed downstream), so
+        everything after the exchange — offsets, lookup, output exchange,
+        residuals — is byte-identical to the padded path."""
+        import os
+        flat_sel = jnp.asarray(grp.flat_sel)             # [S]
+        s_rows = int(grp.f_per_rank.sum())
+        me = self._my_index()
+        f_pr = jnp.asarray(grp.f_per_rank)
+        in_off = jnp.asarray(grp.in_offsets)
+        out_off = jnp.full((world,), me * grp.f_max, jnp.int32)
+        recv_sz = jnp.full((world,), jnp.take(f_pr, me), jnp.int32)
+        native_env = os.environ.get("DET_RAGGED_NATIVE", "auto")
+        native = (pallas_lookup.is_tpu_backend() if native_env == "auto"
+                  else native_env == "1")
+
+        def exchange(x):                                 # [B_l, n_g, k]
+            send = jnp.take(x, flat_sel, axis=1)         # [B_l, S, k]
+            send = jnp.moveaxis(send, 1, 0).reshape(
+                s_rows, blocal * grp.k)
+            out_buf = jnp.zeros((world * grp.f_max, blocal * grp.k),
+                                send.dtype)
+            recv = _ragged_exchange_op(send, out_buf, in_off, f_pr,
+                                       out_off, recv_sz, self.axis, native)
+            recv = recv.reshape(world, grp.f_max, blocal, grp.k)
+            return jnp.moveaxis(recv, 2, 1).reshape(-1, grp.f_max, grp.k)
+
+        return exchange(ids), None if w is None else exchange(w)
 
     def _tp_group_out(self, tp_params, grp, ids_x, w_x, tap):
         """One exchange group's local bucket output [B, f, w_out], via the

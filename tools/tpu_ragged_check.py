@@ -39,15 +39,19 @@ def main():
 
     f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P("x"),),
                               out_specs=P("x")))
-    x = jnp.arange(n, dtype=jnp.float32)
-    t0 = time.perf_counter()
-    got = np.asarray(jax.block_until_ready(f(x)))
-    dt = time.perf_counter() - t0
-    want = np.full((n,), -1.0, np.float32)
-    want[2:7] = np.arange(5, dtype=np.float32)
-    np.testing.assert_array_equal(got, want)
-    print(f"ragged_all_to_all: LOWERS + CORRECT on "
-          f"{d[0].platform} (compile+run {dt:.1f}s)", flush=True)
+    # f32 AND int32: the distributed forward's ragged path
+    # (DET_RAGGED_EXCHANGE) moves int32 ids
+    for dtype in (jnp.float32, jnp.int32):
+        x = jnp.arange(n, dtype=dtype)
+        t0 = time.perf_counter()
+        got = np.asarray(jax.block_until_ready(f(x)))
+        dt = time.perf_counter() - t0
+        want = np.full((n,), -1.0, np.float32).astype(dtype)
+        want[2:7] = np.arange(5).astype(dtype)
+        np.testing.assert_array_equal(got, want)
+        print(f"ragged_all_to_all[{jnp.dtype(dtype).name}]: LOWERS + "
+              f"CORRECT on {d[0].platform} (compile+run {dt:.1f}s)",
+              flush=True)
 
 
 if __name__ == "__main__":
