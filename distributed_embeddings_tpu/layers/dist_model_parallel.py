@@ -178,7 +178,10 @@ def _ragged_exchange_op(operand, output, in_off, send_sz, out_off, recv_sz,
     n_out = output.shape[0]
     i = jnp.arange(n_out)
     starts = g_out[:, me]                          # my chunk starts, per src
-    sizes = g_send[:, me]
+    # receive extent honors BOTH sides' metadata (sender's send_sz and my
+    # recv_sz), so a wrong recv_sz corrupts the emulation the same way it
+    # would corrupt the native op — CPU tests catch it
+    sizes = jnp.minimum(g_send[:, me], recv_sz)
     src0 = g_in[:, me]
     m = ((i[None, :] >= starts[:, None])
          & (i[None, :] < (starts + sizes)[:, None]))   # [world, n_out]
