@@ -178,6 +178,45 @@ def main():
     sums = jax.jit(lambda *os: [jnp.sum(o * o) for o in os])(*mp_outs)
     checks["mp_fwd"] = [round(float(s), 4) for s in sums]
 
+    # true-splits exchange under REAL cross-process collectives: the
+    # ragged-exchange emulation (all_gather + masked gather) must produce
+    # the same forward as the padded path over gloo, not just on the
+    # single-process virtual mesh
+    prev_rg = os.environ.get("DET_RAGGED_EXCHANGE")
+    os.environ["DET_RAGGED_EXCHANGE"] = "1"
+    try:
+        dist_rg = DistributedEmbedding(
+            [Embedding(v, w, combiner="sum") for v, w in sizes[1:-1]],
+            mesh=mesh, strategy="comm_balanced",
+            input_max_hotness=[3] * len(sizes[1:-1]))
+        rg_params = dist_rg.set_weights(weights[1:-1])
+        rg_rng = np.random.RandomState(31)
+        rg_global = [rg_rng.randint(0, v, size=(batch, 3)).astype(np.int32)
+                     for v, _ in sizes[1:-1]]
+        rg_inputs = stage_dp_batch(mesh, [g[lo:hi] for g in rg_global])
+        rg_fwd = jax.jit(
+            lambda p, xs: [jnp.sum(o * o) for o in dist_rg.apply(p, xs)])
+        rg_sums = [float(s) for s in rg_fwd(rg_params, rg_inputs)]
+        checks["ragged_exchange_fwd"] = [round(s, 4) for s in rg_sums]
+    finally:
+        if prev_rg is None:
+            os.environ.pop("DET_RAGGED_EXCHANGE", None)
+        else:
+            os.environ["DET_RAGGED_EXCHANGE"] = prev_rg
+    # and the padded path on the same model/inputs must agree in-process
+    # (tolerance, not bit equality: the two paths reduce in different
+    # orders — same contract as test_exchange's allclose)
+    dist_pd = DistributedEmbedding(
+        [Embedding(v, w, combiner="sum") for v, w in sizes[1:-1]],
+        mesh=mesh, strategy="comm_balanced",
+        input_max_hotness=[3] * len(sizes[1:-1]))
+    pd_fwd = jax.jit(
+        lambda p, xs: [jnp.sum(o * o) for o in dist_pd.apply(p, xs)])
+    pd_sums = [float(s)
+               for s in pd_fwd(dist_pd.set_weights(weights[1:-1]),
+                               rg_inputs)]
+    np.testing.assert_allclose(rg_sums, pd_sums, rtol=1e-5, atol=1e-5)
+
     # fit loop with ITERABLE per-process data: exercises fit's default
     # mesh-aware staging (stage_dp_batch / make_array_from_process_local_
     # data) — a committed single-device device_put cannot be resharded
