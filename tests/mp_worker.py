@@ -205,11 +205,20 @@ def main():
             os.environ["DET_RAGGED_EXCHANGE"] = prev_rg
     # and the padded path on the same model/inputs must agree in-process
     # (tolerance, not bit equality: the two paths reduce in different
-    # orders — same contract as test_exchange's allclose)
-    dist_pd = DistributedEmbedding(
-        [Embedding(v, w, combiner="sum") for v, w in sizes[1:-1]],
-        mesh=mesh, strategy="comm_balanced",
-        input_max_hotness=[3] * len(sizes[1:-1]))
+    # orders — same contract as test_exchange's allclose). Force the flag
+    # OFF here — if the caller exported DET_RAGGED_EXCHANGE=1 the restore
+    # above would otherwise make this a vacuous ragged-vs-ragged compare.
+    os.environ["DET_RAGGED_EXCHANGE"] = "0"
+    try:
+        dist_pd = DistributedEmbedding(
+            [Embedding(v, w, combiner="sum") for v, w in sizes[1:-1]],
+            mesh=mesh, strategy="comm_balanced",
+            input_max_hotness=[3] * len(sizes[1:-1]))
+    finally:
+        if prev_rg is None:
+            os.environ.pop("DET_RAGGED_EXCHANGE", None)
+        else:
+            os.environ["DET_RAGGED_EXCHANGE"] = prev_rg
     pd_fwd = jax.jit(
         lambda p, xs: [jnp.sum(o * o) for o in dist_pd.apply(p, xs)])
     pd_sums = [float(s)
