@@ -259,7 +259,7 @@ def _offload_vs_device_sparse(specs, optimizer, dedup, placement, budget,
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("seed", range(10))
 def test_random_sparse_train_equivalence(seed):
     """Randomized sparse TRAINING equivalence: optimizer x dedup strategy x
     placement x host-offload corners (the named cases in test_sparse_train /
