@@ -287,18 +287,29 @@ def supervise() -> int:
 def _outage_evidence() -> str:
     """Summarize the background claim watcher's probe history (if present)
     so a failed BENCH artifact documents the outage, not just the symptom."""
-    try:
-        with open("/tmp/claim_watch.log") as f:
-            lines = [ln.strip() for ln in f
-                     if "attempt" in ln or "claim OK" in ln]
-    except OSError:
-        return "(no claim-watcher history available)"
+    import glob
+    paths = sorted(glob.glob("/tmp/claim_watch*.log"), key=os.path.getmtime)
+    lines = []
+    if paths:
+        # newest log only: older rounds' watchers must not be conflated
+        # with the current outage
+        try:
+            with open(paths[-1]) as f:
+                lines = [ln.strip() for ln in f
+                         if "attempt" in ln or "probe" in ln
+                         or "claim OK" in ln or "SUCCESS" in ln]
+        except OSError:
+            pass
     if not lines:
-        return "(claim-watcher history empty)"
+        return "(no claim-watcher history available)"
     fails = sum("failed" in ln for ln in lines)
-    return (f"claim-watcher history: {fails} failed probes, "
-            f"first={lines[0]!r} last={lines[-1]!r} — TPU tunnel claim "
-            "wedged (jax.devices() hangs; see docs/round2_notes.md)")
+    older = (f" ({len(paths) - 1} older watcher log(s) not counted)"
+             if len(paths) > 1 else "")
+    return (f"claim-watcher history [{paths[-1].rsplit('/', 1)[-1]}]: "
+            f"{fails} failed probes, first={lines[0]!r} "
+            f"last={lines[-1]!r}{older} — TPU tunnel claim wedged "
+            "(jax.devices() hangs; see docs/round2_notes.md and "
+            "TPU_OUTAGE_r03.log)")
 
 
 def main():
