@@ -233,11 +233,17 @@ def _narrow_path_ok(width: int, dtype) -> bool:
     sub-lane rows (the suite only exercises interpret mode, so a TPU
     lowering bug in sub-lane row tiles would otherwise yield silently wrong
     embeddings; bf16 tables take a different Mosaic tiling than f32, so
-    dtype is part of the key). Runs eagerly at first trace; a mismatch
-    warns and pins the combination to the XLA fallback for the process."""
+    dtype is part of the key). Must run EAGERLY (it executes a compiled
+    kernel and fetches the result — illegal under a jit trace); callers
+    inside a trace consult the cache via ``prevalidate_narrow`` instead.
+    A mismatch or compile failure warns and pins the combination to the
+    XLA fallback for the process (round-3 hardware: the r03 tunnel's
+    compile helper crashed on every DMA-kernel compile, so the failure
+    path is load-bearing, not theoretical)."""
     key = (width, jnp.dtype(dtype).name)
     if key in _NARROW_VALIDATED:
         return _NARROW_VALIDATED[key]
+    import warnings
     rng = np.random.RandomState(width)
     vocab = ONEHOT_MAX_VOCAB + 64
     table = jnp.asarray(rng.randn(vocab, width), dtype=dtype)
@@ -247,19 +253,34 @@ def _narrow_path_ok(width: int, dtype) -> bool:
     # a toy-shape probe
     ids = jnp.asarray(rng.randint(0, vocab, (500, 4)).astype(np.int32))
     w = jnp.asarray(rng.rand(500, 4).astype(np.float32))
-    got = np.asarray(_dma_gather_lookup(table, ids, w, interpret=False))
+    try:
+        got = np.asarray(_dma_gather_lookup(table, ids, w, interpret=False))
+    except Exception as e:  # noqa: BLE001 - any compile/run failure => XLA
+        warnings.warn(
+            f"DET_PALLAS_NARROW: DMA kernel failed to compile/run at "
+            f"width {width} dtype {jnp.dtype(dtype).name} on this backend "
+            f"({str(e)[:200]}); falling back to XLA")
+        _NARROW_VALIDATED[key] = False
+        return False
     want = np.einsum("bk,bkw->bw", np.asarray(w),
                      np.asarray(table, np.float32)[np.asarray(ids)])
     tol = 1e-5 if jnp.dtype(dtype) == jnp.float32 else 1e-2
     ok = bool(np.allclose(got, want, rtol=tol, atol=tol))
     if not ok:
-        import warnings
         warnings.warn(
             f"DET_PALLAS_NARROW: DMA kernel mismatches XLA gather at "
             f"width {width} dtype {jnp.dtype(dtype).name} on this "
             "backend; falling back to XLA")
     _NARROW_VALIDATED[key] = ok
     return ok
+
+
+def prevalidate_narrow(widths=(8, 16, 32, 64), dtype=jnp.float32) -> dict:
+    """Eagerly run the narrow-width hardware validation for each width so
+    traced code (jit/shard_map forwards) can consult the cached verdicts.
+    Call BEFORE the first traced forward when DET_PALLAS_NARROW=1; inside a
+    trace an unvalidated width silently takes the XLA fallback."""
+    return {w: _narrow_path_ok(w, dtype) for w in widths}
 
 
 def _fused_impl(params, ids, weights, interpret):
@@ -271,9 +292,16 @@ def _fused_impl(params, ids, weights, interpret):
     # beats XLA's gather is a hardware question — opt in via env until the
     # prims data answers it
     narrow_ok = os.environ.get("DET_PALLAS_NARROW", "0") == "1"
-    use_narrow = (narrow_ok and width in (8, 16, 32, 64)
-                  and (_interpret_default(interpret)
-                       or _narrow_path_ok(width, params.dtype)))
+    if narrow_ok and not _interpret_default(interpret):
+        # under a jit trace the eager hardware check cannot run (it fetches
+        # a compiled result); only a cached prevalidate_narrow verdict
+        # enables the path there
+        key = (width, jnp.dtype(params.dtype).name)
+        if isinstance(params, jax.core.Tracer):
+            narrow_ok = _NARROW_VALIDATED.get(key, False)
+        else:
+            narrow_ok = _narrow_path_ok(width, params.dtype)
+    use_narrow = narrow_ok and width in (8, 16, 32, 64)
     if width % _LANE == 0 or use_narrow:
         return _dma_gather_lookup(params, ids, weights, interpret=interpret)
     # XLA fallback: gather + weighted reduce (still fused by XLA)

@@ -20,7 +20,32 @@ from typing import Any, Callable, NamedTuple, Sequence
 import jax
 
 __all__ = ["BenchResult", "benchmark", "benchmark_batches", "trace",
-           "annotate"]
+           "annotate", "fetch_sync"]
+
+
+def fetch_sync(out) -> float:
+    """Drain the device queue by FETCHING a value derived from ``out``.
+
+    ``jax.block_until_ready`` is not a reliable sync on every backend: on the
+    experimental remote-attached 'axon' TPU platform it was observed (round 3,
+    2026-07-31) to return before device work finished, yielding physically
+    impossible timings — e.g. a 2.9M-key sort "measured" at 15us and a train
+    step 63x FASTER than the chip's HBM roofline. A host fetch of a scalar
+    reduced from the outputs cannot complete before the data exists, so a
+    fetch is the sync of record for all timing in this repo.
+
+    Device-side cost is one reduction per leaf fused into one tiny transfer
+    each; returns the summed scalar so callers can finite-check it.
+    """
+    import jax.numpy as jnp
+    total = 0.0
+    for leaf in jax.tree.leaves(out):
+        if not hasattr(leaf, "dtype"):
+            continue
+        if jnp.issubdtype(leaf.dtype, jnp.bool_):
+            continue
+        total += float(jnp.sum(leaf.astype(jnp.float32)))
+    return total
 
 
 class BenchResult(NamedTuple):
@@ -49,21 +74,22 @@ def benchmark(fn: Callable, *args, iters: int = 20, warmup: int = 2,
     """
     t0 = time.perf_counter()
     out = fn(*args, **kwargs)
-    jax.block_until_ready(out)
+    fetch_sync(out)
     compile_s = time.perf_counter() - t0
     for _ in range(warmup):
         out = fn(*args, **kwargs)
         # sync EVERY call: XLA:CPU's in-process collectives deadlock when
         # several collective-bearing executions are queued concurrently
         # (rendezvous termination after 40s); on TPU this just serializes
-        # warmup, which is fine
-        jax.block_until_ready(out)
+        # warmup, which is fine. fetch_sync, not block_until_ready: the
+        # latter lies on the axon platform (see its docstring)
+        fetch_sync(out)
 
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
-        jax.block_until_ready(out)
+        fetch_sync(out)
         times.append(time.perf_counter() - t0)
     return BenchResult(mean_s=statistics.mean(times),
                        p50_s=statistics.median(times),
@@ -77,17 +103,17 @@ def benchmark_batches(fn: Callable, batches: Sequence, iters: int = 20,
     averaged. fn is called as fn(*batches[i % len(batches)])."""
     t0 = time.perf_counter()
     out = fn(*batches[0])
-    jax.block_until_ready(out)
+    fetch_sync(out)
     compile_s = time.perf_counter() - t0
     for i in range(warmup):
         out = fn(*batches[i % len(batches)])
-        jax.block_until_ready(out)   # see benchmark(): CPU collective safety
+        fetch_sync(out)   # see benchmark(): CPU collective safety + axon sync
 
     times = []
     for i in range(iters):
         t0 = time.perf_counter()
         out = fn(*batches[i % len(batches)])
-        jax.block_until_ready(out)
+        fetch_sync(out)
         times.append(time.perf_counter() - t0)
     return BenchResult(mean_s=statistics.mean(times),
                        p50_s=statistics.median(times),
@@ -122,17 +148,33 @@ def benchmark_chained(step: Callable, state, iters: int = 20) -> BenchResult:
     forcing inter-iteration dependencies. Immune to per-dispatch latency and
     async-dispatch ambiguity (both observed to distort per-call timing over
     remote-attached TPUs); wall-clock / iters is pure device time.
+
+    Timing is SLOPE-BASED with fetch sync (see ``fetch_sync``): the loop
+    program runs once (t1) and then twice back-to-back (t2); per-iter time is
+    (t2 - t1) / iters, which cancels every constant overhead — dispatch,
+    fetch round-trip, queue drain — even on backends where
+    ``block_until_ready`` is unreliable.
     """
     from jax import lax
 
     lf = jax.jit(lambda s: lax.fori_loop(0, iters, lambda i, s: step(s), s))
     t0 = time.perf_counter()
     out = lf(state)
-    jax.block_until_ready(out)
+    fetch_sync(out)
     compile_s = time.perf_counter() - t0
+
     t0 = time.perf_counter()
     out = lf(state)
-    jax.block_until_ready(out)
-    per_iter = (time.perf_counter() - t0) / iters
-    return BenchResult(mean_s=per_iter, p50_s=per_iter, min_s=per_iter,
-                       iters=iters, compile_s=compile_s)
+    fetch_sync(out)
+    t1 = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = lf(state)
+    out = lf(out)
+    fetch_sync(out)
+    t2 = time.perf_counter() - t0
+
+    per_iter = max(t2 - t1, 1e-9) / iters
+    return BenchResult(mean_s=per_iter, p50_s=per_iter,
+                       min_s=min(per_iter, t1 / iters), iters=iters,
+                       compile_s=compile_s)

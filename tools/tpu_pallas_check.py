@@ -39,14 +39,45 @@ def make_case(rng, batch, vocab, width, hot):
     return jnp.asarray(table), jnp.asarray(ids), jnp.asarray(weights)
 
 
-def bench(fn, *args, iters=50):
-    out = fn(*args)
-    jax.block_until_ready(out)
+def bench(fn, table, ids, weights, iters=20):
+    """Chained-program slope timing with fetch sync.
+
+    Round-3 axon findings, in order of discovery: (1) block_until_ready
+    returns early, so a host FETCH of the result is the only real sync;
+    (2) repeated identical calls whose outputs are never fetched may never
+    execute at all (50 queued lookups "ran" in 0.000 ms), so the measured
+    program must CHAIN — each iteration's input depends on the previous
+    iteration's output. One jitted fori_loop carries a zero-valued
+    dependency (input values stay identical; the data dependency is real),
+    and per-iter time is (t(2N) - t(N)) / N so constant dispatch/fetch
+    overhead cancels."""
+    from jax import lax
+
+    def loop(w):
+        def body(i, s):
+            w, acc = s
+            out = fn(table, ids, w)
+            dep = (out[:1, :1] * 0).astype(w.dtype)
+            return (w + dep, acc + out[0, 0].astype(jnp.float32))
+        return lax.fori_loop(0, iters, body, (w, jnp.float32(0)))
+
+    lf = jax.jit(loop)
+
+    def fetch(o):
+        return float(o[1])
+
+    out = lf(weights)
+    fetch(out)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1e3
+    out = lf(weights)
+    fetch(out)
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = lf(weights)
+    out = lf(out[0])
+    fetch(out)
+    t2 = time.perf_counter() - t0
+    return max(t2 - t1, 1e-9) / iters * 1e3
 
 
 def main():

@@ -4,17 +4,29 @@ Each measurement runs the op `iters` times inside ONE jitted computation with
 a forced data dependency between iterations (the output perturbs the next
 input), so XLA cannot hoist, DCE, or overlap the work away; the tunnel
 dispatch cost is paid once.
+
+Sync + timing (round-3 hardware finding): `block_until_ready` is unreliable
+on the axon tunnel — it returned early and "timed" a 2.9M-key sort at 15us.
+Every chain is therefore timed slope-style with a host FETCH as the sync:
+run the loop program once (t1) and twice back-to-back (t2); per-iter =
+(t2 - t1) / iters. Constant overheads (dispatch, fetch RTT, queue drain)
+cancel in the subtraction. See utils/profiling.fetch_sync.
 """
 
 import argparse
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from distributed_embeddings_tpu.utils.profiling import fetch_sync
 
 RESULTS = {}
 _ITERS = 10
@@ -31,13 +43,22 @@ def timed_chain(make_fn, init_state, iters=None, label="", n_rows=None):
 
     lf = jax.jit(loop)
     out = lf(init_state)
-    jax.block_until_ready(out)
+    fetch_sync(out)                      # warm + drain the queue
     t0 = time.perf_counter()
     out = lf(init_state)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
-    print(f"{label}: {dt * 1e3:.3f} ms/iter", flush=True)
-    RESULTS[label] = {"ms": round(dt * 1e3, 3)}
+    fetch_sync(out)
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = lf(init_state)
+    out = lf(out)
+    fetch_sync(out)
+    t2 = time.perf_counter() - t0
+    dt = max(t2 - t1, 1e-9) / iters
+    print(f"{label}: {dt * 1e3:.3f} ms/iter "
+          f"(t1={t1 * 1e3:.1f}ms t2={t2 * 1e3:.1f}ms)", flush=True)
+    RESULTS[label] = {"ms": round(dt * 1e3, 3),
+                      "t1_ms": round(t1 * 1e3, 1),
+                      "t2_ms": round(t2 * 1e3, 1)}
     if n_rows:
         RESULTS[label]["ns_per_row"] = round(dt / n_rows * 1e9, 1)
     return dt
