@@ -1,14 +1,20 @@
 #!/bin/bash
-# TPU claim watcher (round 3).
-# Probes the axon tunnel every 4 minutes with a killable subprocess.
-# On the FIRST successful probe it runs the full serialized validation
-# pipeline (tools/tpu_validate.py) and then bench.py, committing artifacts.
-# Serializes all TPU access: never runs two TPU-touching processes at once.
-# Log: /tmp/claim_watch_r03.log   Sentinel on success: /tmp/tpu_alive_r03
+# TPU claim watcher (round 3, phase 2 — post-first-measurement).
+# The round's headline numbers landed (tools/tpu_validate_out.json, commit
+# a2b335e); the tunnel then wedged again. On recovery this watcher runs the
+# remaining OPEN measurements, cheapest-first, each in its own killable
+# subprocess:
+#   1. tpu_mosaic_probe   — which Pallas feature crashes the compile helper
+#   2. tpu_scatter_probe  — unique/sorted scatter-gather flag effect
+#   3. tpu_pallas_check   — kernel vs XLA timing with the FIXED slope timer
+#   4. bench.py           — refreshed headline (picks up sparse-update tuning)
+# Logs: /root/repo/tools/claim_watch_r03c.log  Sentinel: /tmp/tpu_alive_r03c
 set -u
-LOG=/tmp/claim_watch_r03.log
+LOG=/root/repo/tools/claim_watch_r03c.log
 cd /root/repo
-echo "$(date +%H:%M:%S) watcher start" >> "$LOG"
+export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache_det_tpu
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+echo "$(date +%H:%M:%S) watcher start (phase 2)" >> "$LOG"
 n=0
 while true; do
   n=$((n+1))
@@ -19,23 +25,25 @@ import jax
 d = jax.devices()
 print(d)
 assert d and d[0].platform != 'cpu', f'cpu fallback: {d}'
+import jax.numpy as jnp
+print('fetch', float(jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128)))))
 " >> "$LOG" 2>&1; then
     echo "$(date +%H:%M:%S) probe $n SUCCESS — tunnel alive" >> "$LOG"
-    touch /tmp/tpu_alive_r03
-    echo "$(date +%H:%M:%S) running tpu_validate" >> "$LOG"
-    timeout 3600 python tools/tpu_validate.py >> "$LOG" 2>&1
-    rc_val=$?
-    echo "$(date +%H:%M:%S) tpu_validate rc=$rc_val" >> "$LOG"
-    echo "$(date +%H:%M:%S) running bench.py" >> "$LOG"
-    timeout 3600 python bench.py > /tmp/bench_r03_out.json 2>> "$LOG"
-    rc_bench=$?
-    echo "$(date +%H:%M:%S) bench rc=$rc_bench" >> "$LOG"
-    # success sentinel only when the measurements actually landed
-    if [ "$rc_bench" -eq 0 ] && [ -s /tmp/bench_r03_out.json ]; then
-      touch /tmp/tpu_measured_r03
-      exit 0
-    fi
-    echo "$(date +%H:%M:%S) measurement failed; resuming watch" >> "$LOG"
+    touch /tmp/tpu_alive_r03c
+    for stage in "tools/tpu_mosaic_probe.py:900:mosaic" \
+                 "tools/tpu_scatter_probe.py:2700:scatter" \
+                 "tools/tpu_pallas_check.py --quick:2700:pallas" \
+                 "bench.py:7200:bench"; do
+      cmd=${stage%%:*}; rest=${stage#*:}; secs=${rest%%:*}; name=${rest#*:}
+      echo "$(date +%H:%M:%S) running $name" >> "$LOG"
+      # shellcheck disable=SC2086
+      timeout "$secs" python -u $cmd \
+        > "tools/watch_${name}_r03c.out" 2>&1
+      echo "$(date +%H:%M:%S) $name rc=$?" >> "$LOG"
+      sleep 20
+    done
+    touch /tmp/tpu_measured_r03c
+    exit 0
   else
     echo "$(date +%H:%M:%S) probe $n failed" >> "$LOG"
   fi

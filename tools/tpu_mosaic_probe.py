@@ -1,0 +1,184 @@
+"""Bisect WHICH Pallas/Mosaic feature crashes this tunnel's compile helper.
+
+Round-3 hardware: the one-hot MXU kernel compiles and runs, but every
+DMA-gather kernel compile dies with `remote_compile HTTP 500:
+tpu_compile_helper subprocess exit code 1` (no Mosaic diagnostic crosses the
+tunnel). The same kernels compiled in round 2's hardware window, so the
+toolchain changed. This probe compiles a ladder of minimal kernels, each
+adding ONE feature the DMA kernel uses, and reports the first rung that
+fails:
+
+  1. vmem      — trivial VMEM elementwise kernel (control)
+  2. anyspace  — table input left in ANY (HBM) memory space, static slice
+  3. dma       — one explicit make_async_copy HBM->VMEM + semaphore
+  4. dyn_dma   — async copy with a DYNAMIC row index (table.at[row])
+  5. prefetch  — PrefetchScalarGridSpec with ids in SMEM driving the index
+  6. loop_dma  — fori_loop issuing start()/wait() pairs (the full pattern)
+
+Each rung compiles in a fresh jit; failures print the rung name + error head
+and continue, so one run gives the full feature matrix.
+
+Usage: python tools/tpu_mosaic_probe.py
+"""
+
+import functools
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+V, W, B = 4096, 128, 256
+
+
+def rung_vmem():
+    def kern(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    x = jnp.ones((B, W), jnp.float32)
+    out = pl.pallas_call(
+        kern, out_shape=jax.ShapeDtypeStruct((B, W), jnp.float32))(x)
+    assert float(out[0, 0]) == 2.0
+
+
+def rung_anyspace():
+    def kern(t_ref, o_ref, s_ref):
+        pltpu.make_async_copy(t_ref.at[0:B], s_ref, None)  # build only
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    t = jnp.ones((V, W), jnp.float32)
+    out = pl.pallas_call(
+        kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_shape=jax.ShapeDtypeStruct((B, W), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((B, W), jnp.float32)],
+    )(t)
+    assert out.shape == (B, W)
+
+
+def rung_dma():
+    def kern(t_ref, o_ref, s_ref, sem):
+        cp = pltpu.make_async_copy(t_ref.at[0:B], s_ref, sem)
+        cp.start()
+        cp.wait()
+        o_ref[:] = s_ref[:]
+
+    t = jnp.full((V, W), 3.0, jnp.float32)
+    out = pl.pallas_call(
+        kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_shape=jax.ShapeDtypeStruct((B, W), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((B, W), jnp.float32),
+                        pltpu.SemaphoreType.DMA],
+    )(t)
+    assert float(out[0, 0]) == 3.0
+
+
+def rung_dyn_dma():
+    def kern(i_ref, t_ref, o_ref, s_ref, sem):
+        row = i_ref[0]
+        cp = pltpu.make_async_copy(t_ref.at[row], s_ref.at[0], sem)
+        cp.start()
+        cp.wait()
+        o_ref[:] = s_ref[:]
+
+    t = jnp.full((V, W), 5.0, jnp.float32)
+    idx = jnp.asarray([7], jnp.int32)
+    out = pl.pallas_call(
+        kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_shape=jax.ShapeDtypeStruct((1, W), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, W), jnp.float32),
+                        pltpu.SemaphoreType.DMA],
+    )(idx, t)
+    assert float(out[0, 0]) == 5.0
+
+
+def rung_prefetch():
+    def kern(ids_ref, t_ref, o_ref, s_ref, sem):
+        row = ids_ref[pl.program_id(0)]
+        cp = pltpu.make_async_copy(t_ref.at[row], s_ref.at[0], sem)
+        cp.start()
+        cp.wait()
+        o_ref[:] = s_ref[:]
+
+    t = jnp.full((V, W), 7.0, jnp.float32)
+    ids = jnp.arange(4, dtype=jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(4,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec((1, W), lambda i, ids_ref: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((1, W), jnp.float32),
+                        pltpu.SemaphoreType.DMA],
+    )
+    out = pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((4, W), jnp.float32))(ids, t)
+    assert float(out[0, 0]) == 7.0
+
+
+def rung_loop_dma():
+    n = 8
+
+    def kern(i_ref, t_ref, o_ref, s_ref, sems):
+        def issue(j, _):
+            row = i_ref[j]
+            pltpu.make_async_copy(t_ref.at[row], s_ref.at[j],
+                                  sems.at[j]).start()
+            return 0
+
+        jax.lax.fori_loop(0, n, issue, 0)
+
+        def drain(j, _):
+            row = i_ref[j]
+            pltpu.make_async_copy(t_ref.at[row], s_ref.at[j],
+                                  sems.at[j]).wait()
+            return 0
+
+        jax.lax.fori_loop(0, n, drain, 0)
+        o_ref[:] = jnp.sum(s_ref[:], axis=0, keepdims=True)
+
+    t = jnp.ones((V, W), jnp.float32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    out = pl.pallas_call(
+        kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_shape=jax.ShapeDtypeStruct((1, W), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((n, W), jnp.float32),
+                        pltpu.SemaphoreType.DMA((n,))],
+    )(idx, t)
+    assert float(out[0, 0]) == float(n)
+
+
+RUNGS = [("vmem", rung_vmem), ("anyspace", rung_anyspace), ("dma", rung_dma),
+         ("dyn_dma", rung_dyn_dma), ("prefetch", rung_prefetch),
+         ("loop_dma", rung_loop_dma)]
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+    results = {}
+    for name, fn in RUNGS:
+        t0 = time.perf_counter()
+        try:
+            fn()
+            results[name] = "ok"
+            print(f"ok   {name} ({time.perf_counter() - t0:.1f}s)",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001 - report every rung
+            results[name] = f"FAIL {str(e)[:160]}"
+            print(f"FAIL {name}: {str(e)[:300]}", flush=True)
+    import json
+    print(json.dumps(results), flush=True)
+    return 0 if all(v == "ok" for v in results.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

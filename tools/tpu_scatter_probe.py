@@ -1,0 +1,114 @@
+"""Measure how much XLA:TPU scatter/gather cost drops when the indices are
+promised unique and/or sorted.
+
+Round-3 prims data: row scatter-add is THE bottleneck on this chip
+(~100-280 ns/row — a 720k-row update costs 74 ms while the same bytes
+stream in ~0.2 ms), and the sparse-update sort path scatters with ids that
+ARE sorted+unique post-dedup but never says so, forcing XLA's conservative
+duplicate-safe lowering. This probe times every (flags x shape) combination
+the framework's update paths use, chained + fetch-synced (see
+utils/profiling.fetch_sync for why).
+
+Usage: python tools/tpu_scatter_probe.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+RESULTS = {}
+
+
+def timed_chain(step, state, iters=8, label=""):
+    def loop(s):
+        return lax.fori_loop(0, iters, lambda i, x: step(x), s)
+
+    lf = jax.jit(loop)
+    out = lf(state)
+    _fetch(out)
+    t0 = time.perf_counter()
+    out = lf(state)
+    _fetch(out)
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = lf(state)
+    out = lf(out)
+    _fetch(out)
+    t2 = time.perf_counter() - t0
+    dt = max(t2 - t1, 1e-9) / iters
+    print(f"{label}: {dt * 1e3:.3f} ms/iter", flush=True)
+    RESULTS[label] = round(dt * 1e3, 3)
+    return dt
+
+
+def _fetch(out):
+    total = 0.0
+    for leaf in jax.tree.leaves(out):
+        if hasattr(leaf, "dtype"):
+            total += float(jnp.sum(leaf.astype(jnp.float32)))
+    return total
+
+
+def unique_sorted_ids(rng, n, v):
+    """Strictly increasing in-bounds ids: sorted sample + arange offset."""
+    return np.sort(rng.integers(0, v - n, n).astype(np.int64)) + np.arange(n)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+    rng = np.random.default_rng(0)
+
+    # --- width 16 (tiny-model class): V=25M, n=720896 rows
+    for (v, n, w) in ((25_000_000, 720_896, 16), (2_600_000, 1_703_936, 128)):
+        tag = f"V={v//1000}k n={n} w={w}"
+        dup_ids = jnp.asarray(rng.integers(0, v, n).astype(np.int32))
+        uniq = jnp.asarray(unique_sorted_ids(rng, n, v).astype(np.int32))
+        rows = jnp.asarray(rng.standard_normal((n, w), dtype=np.float32))
+        table = jnp.zeros((v, w), jnp.float32)
+
+        def mk_scatter(ids, unique, sorted_):
+            def step(s):
+                t, r = s
+                t = t.at[ids].add(r, mode="drop", unique_indices=unique,
+                                  indices_are_sorted=sorted_)
+                # chain: next iteration's rows depend on this scatter
+                return t, r + t[0, :1] * 0
+            return step
+
+        timed_chain(mk_scatter(dup_ids, False, False), (table, rows),
+                    label=f"scatter-add dupes noflags {tag}")
+        timed_chain(mk_scatter(uniq, False, False), (table, rows),
+                    label=f"scatter-add uniqsorted noflags {tag}")
+        timed_chain(mk_scatter(uniq, True, False), (table, rows),
+                    label=f"scatter-add uniqsorted unique {tag}")
+        timed_chain(mk_scatter(uniq, True, True), (table, rows),
+                    label=f"scatter-add uniqsorted unique+sorted {tag}")
+
+        def mk_gather(ids, unique, sorted_):
+            def step(s):
+                t, i = s
+                out = jnp.take(t, i, axis=0, mode="clip",
+                               unique_indices=unique,
+                               indices_are_sorted=sorted_)
+                return t, (i + out[0, 0].astype(jnp.int32) % 2)
+            return step
+
+        timed_chain(mk_gather(dup_ids, False, False), (table, dup_ids),
+                    label=f"gather dupes noflags {tag}")
+        timed_chain(mk_gather(uniq, True, True), (table, uniq),
+                    label=f"gather uniqsorted unique+sorted {tag}")
+
+    print(json.dumps(RESULTS), flush=True)
+
+
+if __name__ == "__main__":
+    main()
