@@ -106,6 +106,93 @@ def main():
                     label=f"gather dupes noflags {tag}")
         timed_chain(mk_gather(uniq, True, True), (table, uniq),
                     label=f"gather uniqsorted unique+sorted {tag}")
+        # sorted-with-duplicates gather: the shape a sorted-lookup forward
+        # would issue (sort ids once, gather with locality, inverse-permute)
+        sdup = jnp.sort(dup_ids)
+        timed_chain(mk_gather(sdup, False, True), (table, sdup),
+                    label=f"gather dupes sorted {tag}")
+
+        # composite: sort + sorted-gather + inverse-permute vs the raw
+        # unsorted gather above — the end-to-end decision for a
+        # sorted-lookup forward path
+        def composite(s):
+            t, i = s
+            iota = jnp.arange(i.shape[0], dtype=jnp.int32)
+            sid, perm = lax.sort_key_val(i, iota)
+            rows_srt = jnp.take(t, sid, axis=0, mode="clip",
+                                indices_are_sorted=True)
+            out = jnp.zeros_like(rows_srt).at[perm].set(
+                rows_srt, unique_indices=True)
+            return t, (i + out[0, 0].astype(jnp.int32) % 2)
+
+        timed_chain(composite, (table, dup_ids),
+                    label=f"sort+sortedgather+unperm {tag}")
+        del table, rows, dup_ids, uniq, sdup
+
+    # segment aggregation alternatives: jax.ops.segment_sum(sorted) measured
+    # 45 ns/row in round-3a (it is a sorted-dupes scatter underneath); a
+    # cumsum-difference formulation is pure streaming if XLA lowers cumsum
+    # at bandwidth (cost: ~N*eps precision, acceptable as an opt-in)
+    for w in (16, 128):
+        n = 720_896
+        seg_ids = jnp.asarray(np.sort(rng.integers(0, n, n)).astype(np.int32))
+        rows = jnp.asarray(rng.standard_normal((n, w), dtype=np.float32))
+        starts = jnp.concatenate([jnp.ones((1,), bool),
+                                  seg_ids[1:] != seg_ids[:-1]])
+        seg = jnp.cumsum(starts.astype(jnp.int32)) - 1
+
+        def seg_scatter(s):
+            sg, r = s
+            out = jax.ops.segment_sum(r, sg, num_segments=n,
+                                      indices_are_sorted=True)
+            return (sg + out[0, 0].astype(jnp.int32) % 2) % n, r
+
+        timed_chain(seg_scatter, (seg, rows),
+                    label=f"segment_sum scatter n=720k w={w}")
+
+        sid_sorted = jnp.sort(jnp.asarray(
+            rng.integers(0, n, n).astype(np.int32)))
+
+        def seg_cumsum(s):
+            # scatter-FREE per-segment totals over sorted ids: cumsum +
+            # cummax + one sorted gather; totals land at each segment's
+            # END row (other rows zero), which downstream unique-promise
+            # scatters consume just as well as a compacted layout
+            sid, r = s
+            iota = jnp.arange(n, dtype=jnp.int32)
+            is_start = jnp.concatenate(
+                [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+            is_end = jnp.concatenate(
+                [sid[1:] != sid[:-1], jnp.ones((1,), bool)])
+            p = jnp.cumsum(r, axis=0)
+            begin = lax.cummax(jnp.where(is_start, iota, -1))
+            p_prev = jnp.where(
+                (begin > 0)[:, None],
+                jnp.take(p, jnp.maximum(begin - 1, 0), axis=0,
+                         indices_are_sorted=True), 0.0)
+            sums_at_end = jnp.where(is_end[:, None], p - p_prev, 0.0)
+            return (sid + sums_at_end[0, 0].astype(jnp.int32) % 2) % n, r
+
+        timed_chain(seg_cumsum, (sid_sorted, rows),
+                    label=f"segment_sum cumsum-scatterfree n=720k w={w}")
+        del rows
+
+    # the real update path, now carrying the unique+sorted promises — direct
+    # comparison against round-3a prims (sort 200.2ms / dense 93.7ms)
+    from distributed_embeddings_tpu.ops import sparse_update as su
+    v, n = 25_000_000, 720_896
+    tbl = jnp.zeros((v, 16), jnp.float32)
+    acc = jnp.full((v, 16), 0.1, jnp.float32)
+    sids = jnp.asarray(rng.integers(0, v, n).astype(np.int32))
+    contribs = jnp.asarray(rng.standard_normal((n, 16), dtype=np.float32))
+    for strat in ("sort", "dense"):
+        def step8(s, strat=strat):
+            t, a, i = s
+            t2, a2 = su.sparse_adagrad(t, a, su.SparseRowGrad(i, contribs),
+                                       0.01, strategy=strat)
+            return t2, a2, (i * 1103515245 + 12345) % v
+        timed_chain(step8, (tbl, acc, sids), iters=6,
+                    label=f"sparse_adagrad[{strat}]+flags n=720k V=25M")
 
     print(json.dumps(RESULTS), flush=True)
 
