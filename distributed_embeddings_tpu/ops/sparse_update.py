@@ -46,6 +46,26 @@ DENSE_ELEMS_MAX = int(os.environ.get("DET_SPARSE_DENSE_MAX",
                                      16 * 1024 * 1024))
 
 
+def _dedup_impl() -> str:
+    """'sort' (default): segment_sum aggregation — EXACT, and rep comes out
+    strictly increasing so downstream ops promise unique+sorted.
+    'cumsum': scatter-free aggregation (cumsum + cummax + one sorted
+    gather) — round-3 prims measured jax.ops.segment_sum at ~45 ns/row on
+    TPU (it is a sorted-dupes scatter underneath) while cumsum streams at
+    bandwidth; costs ~sqrt(N)*eps relative precision and downgrades the
+    rep promise to unique-only (totals stay at segment-END rows, so OOB
+    fillers interleave). Opt-in until tools/tpu_scatter_probe.py data
+    lands."""
+    return os.environ.get("DET_DEDUP_IMPL", "sort")
+
+
+def dedup_flags() -> dict:
+    """Scatter/gather promise kwargs legal for dedup_sum's rep output under
+    the active implementation (see _dedup_impl)."""
+    return {"unique_indices": True,
+            "indices_are_sorted": _dedup_impl() == "sort"}
+
+
 def take_rows(table: jax.Array, ids: jax.Array) -> jax.Array:
     """Row gather via raw lax.gather with PROMISE_IN_BOUNDS: emits no
     bounds-check constants, so it is legal inside `compute_on` host regions
@@ -114,12 +134,34 @@ def dedup_sum(ids: jax.Array, contribs: jax.Array, sentinel: int):
     rows = jnp.take(contribs, perm, axis=0)
     is_start = jnp.concatenate(
         [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    if _dedup_impl() == "cumsum":
+        return _dedup_sum_cumsum(sid, rows, is_start, sentinel, iota)
     seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1      # exact int prefix
     sums = jax.ops.segment_sum(rows, seg, num_segments=n,
                                indices_are_sorted=True)
     rep = (jnp.int32(sentinel) + iota).at[seg].set(
         sid, mode="drop", indices_are_sorted=True)
     return rep, sums.astype(contribs.dtype)
+
+
+def _dedup_sum_cumsum(sid, rows, is_start, sentinel, iota):
+    """Scatter-free aggregation (see _dedup_impl): per-segment totals land
+    at each segment's END row; every other slot carries a unique OOB
+    filler. rep is unique but NOT sorted (fillers interleave) — consumers
+    must use dedup_flags() rather than hardcoding promises."""
+    n = sid.shape[0]
+    is_end = jnp.concatenate([sid[1:] != sid[:-1], jnp.ones((1,), bool)])
+    p = jnp.cumsum(rows.astype(jnp.float32), axis=0)
+    begin = lax.cummax(jnp.where(is_start, iota, -1))
+    p_prev = jnp.where(
+        (begin > 0)[:, None],
+        jnp.take(p, jnp.maximum(begin - 1, 0), axis=0,
+                 indices_are_sorted=True), 0.0)
+    sums = jnp.where(is_end[:, None], p - p_prev, 0.0)
+    # fillers start at sentinel+1: sid can itself equal sentinel (collapsed
+    # OOB segment), and a filler must never collide with it
+    rep = jnp.where(is_end, sid, jnp.int32(sentinel) + 1 + iota)
+    return rep, sums.astype(rows.dtype)
 
 
 def _dense_sum(ids, contribs, rows):
@@ -165,20 +207,21 @@ def sparse_adagrad(table: jax.Array, accum: jax.Array, grad: SparseRowGrad,
                         -lr * g * lax.rsqrt(acc_new + eps), 0.0)
         return table + upd.astype(table.dtype), acc_new
     rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows)
-    # rep is strictly increasing (dedup_sum contract) => both scatter
-    # promises hold; without them XLA's duplicate-safe lowering costs
-    # ~100-280 ns/row on TPU (round-3 prims measurement)
-    acc_new = accum.at[rep].add(sums * sums, mode="drop",
-                                unique_indices=True, indices_are_sorted=True)
+    # rep is strictly increasing under the default impl (dedup_sum
+    # contract) => both scatter promises hold; without them XLA's
+    # duplicate-safe lowering costs ~100-280 ns/row on TPU (round-3 prims
+    # measurement). dedup_flags() downgrades to unique-only under
+    # DET_DEDUP_IMPL=cumsum
+    fl = dedup_flags()
+    acc_new = accum.at[rep].add(sums * sums, mode="drop", **fl)
     # gather with clamped index is safe: sentinel rows multiply a zero
     # update. Clamping collapses the dropped tail onto rows-1, so only the
-    # sorted promise survives
+    # sorted promise survives (and only under the sort impl)
     acc_rows = jnp.take(acc_new, jnp.minimum(rep, rows - 1), axis=0,
-                        indices_are_sorted=True)
+                        indices_are_sorted=fl["indices_are_sorted"])
     delta = -lr * sums * lax.rsqrt(acc_rows + eps)
     return table.at[rep].add(delta.astype(table.dtype), mode="drop",
-                             unique_indices=True,
-                             indices_are_sorted=True), acc_new
+                             **fl), acc_new
 
 
 # ----------------------------------------------------------------- Adam
@@ -204,20 +247,19 @@ def sparse_adam(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
                         / (jnp.sqrt(nu_new / c2) + eps), 0.0)
         return table + upd.astype(table.dtype), mu_new, nu_new, count
     rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows)
-    # strictly-increasing rep => unique+sorted scatter promises (see
-    # sparse_adagrad); clamped gathers keep only the sorted promise
+    # promises per the active dedup impl (see sparse_adagrad); clamped
+    # gathers keep at most the sorted promise
+    fl = dedup_flags()
+    srt = fl["indices_are_sorted"]
     safe = jnp.minimum(rep, rows - 1)
-    mu_rows = (b1 * jnp.take(mu, safe, axis=0, indices_are_sorted=True)
+    mu_rows = (b1 * jnp.take(mu, safe, axis=0, indices_are_sorted=srt)
                + (1 - b1) * sums)
-    nu_rows = (b2 * jnp.take(nu, safe, axis=0, indices_are_sorted=True)
+    nu_rows = (b2 * jnp.take(nu, safe, axis=0, indices_are_sorted=srt)
                + (1 - b2) * sums * sums)
-    mu_new = mu.at[rep].set(mu_rows, mode="drop", unique_indices=True,
-                            indices_are_sorted=True)
-    nu_new = nu.at[rep].set(nu_rows, mode="drop", unique_indices=True,
-                            indices_are_sorted=True)
+    mu_new = mu.at[rep].set(mu_rows, mode="drop", **fl)
+    nu_new = nu.at[rep].set(nu_rows, mode="drop", **fl)
     delta = -lr * (mu_rows / c1) / (jnp.sqrt(nu_rows / c2) + eps)
-    return (table.at[rep].add(delta.astype(table.dtype), mode="drop",
-                              unique_indices=True, indices_are_sorted=True),
+    return (table.at[rep].add(delta.astype(table.dtype), mode="drop", **fl),
             mu_new, nu_new, count)
 
 
