@@ -230,6 +230,28 @@ def run_dlrm_bench(batches=(65536, 32768, 16384), iters=20):
             e.__traceback__ = None
             del e
             continue
+        extra = {}
+        # dedup-impl A/B (round-3 scatter data): the cumsum impl removes
+        # the segment-sum and rep-build scatters; whether that wins on this
+        # chip is measured here, winner reported
+        if (jax.devices()[0].platform != "cpu"
+                and os.environ.get("DET_BENCH_AB", "1") == "1"):
+            try:
+                os.environ["DET_DEDUP_IMPL"] = "cumsum"
+                dt_cs = run_at_batch(
+                    SyntheticModel(cfg, mesh=None, distributed=True), batch,
+                    iters=iters)
+                extra["dlrm_ab_sort_ms"] = round(dt * 1e3, 3)
+                extra["dlrm_ab_cumsum_ms"] = round(dt_cs * 1e3, 3)
+                if dt_cs < dt:
+                    dt = dt_cs
+                    extra["dlrm_dedup_impl"] = "cumsum"
+                else:
+                    extra["dlrm_dedup_impl"] = "sort"
+            except Exception as e:  # noqa: BLE001 - A/B must not kill bench
+                extra["dlrm_ab_error"] = str(e)[:200]
+            finally:
+                os.environ.pop("DET_DEDUP_IMPL", None)
         dev = jax.devices()[0]
         gen = _chip_gen(dev)
         widths, hot = [], []
@@ -252,6 +274,7 @@ def run_dlrm_bench(batches=(65536, 32768, 16384), iters=20):
             # reference DLRM: 9.16M samples/s on 8xA100 TF32 => 1.145M/GPU
             # (examples/dlrm/README.md:7); per-chip normalized comparison
             "dlrm_vs_ref_per_chip": round(batch / dt / 1_144_734, 3),
+            **extra,
         }
     return {"dlrm_error": last_err or "all batches failed"}
 
@@ -479,6 +502,25 @@ def main():
             finally:
                 os.environ.pop("DET_LOOKUP_PATH", None)
                 os.environ.pop("DET_PALLAS_NARROW", None)
+            # third arm: scatter-free cumsum dedup (round-3 scatter data)
+            try:
+                os.environ["DET_DEDUP_IMPL"] = "cumsum"
+                dt_cs = run_at_batch(
+                    SyntheticModel(cfg, mesh=None, distributed=True), batch)
+                record["tiny_ab_cumsum_ms"] = round(dt_cs * 1e3, 3)
+                if dt_cs * 1e3 < record["value"]:
+                    record["value"] = round(dt_cs * 1e3, 3)
+                    record["vs_baseline"] = round(
+                        (batch / dt_cs) / baseline_throughput, 3)
+                    record["tiny_best_path"] = "xla+cumsum-dedup"
+                    if "tiny_roofline_step_ms" in record:
+                        record["tiny_roofline_frac"] = round(
+                            record["tiny_roofline_step_ms"]
+                            / record["value"], 3)
+            except Exception as e:  # noqa: BLE001
+                record["tiny_ab_cumsum_error"] = str(e)[:200]
+            finally:
+                os.environ.pop("DET_DEDUP_IMPL", None)
         # secondary workload: DLRM samples/sec + HBM roofline (north-star
         # metric, BASELINE.json) — carried in the same single JSON line
         try:
