@@ -33,8 +33,9 @@ PROBE_SRC = (
     "print('devices',d,round(time.perf_counter()-t0,1));"
     "assert d and d[0].platform != 'cpu', f'cpu fallback: {d}';"
     "t0=time.perf_counter();"
-    "jax.block_until_ready(jnp.ones((512,512))@jnp.ones((512,512)));"
-    "print('matmul_s',round(time.perf_counter()-t0,1))"
+    # fetch, not block_until_ready: the latter is not a sync on axon
+    "s=float(jnp.sum(jnp.ones((512,512))@jnp.ones((512,512))));"
+    "print('matmul_s',round(time.perf_counter()-t0,1),'sum',s)"
 )
 
 
