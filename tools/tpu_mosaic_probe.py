@@ -156,9 +156,29 @@ def rung_loop_dma():
     assert float(out[0, 0]) == float(n)
 
 
+def rung_rmw_scatter():
+    """The full production candidate: sorted-unique scatter-add RMW kernel
+    (ops/pallas_scatter.py) at a small shape."""
+    import sys as _sys
+    import os as _os
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    from distributed_embeddings_tpu.ops import pallas_scatter as ps
+    rng = np.random.default_rng(0)
+    v, w, n = 4096, 128, 256
+    ids = jnp.asarray(np.sort(rng.choice(v, n, replace=False))
+                      .astype(np.int32))
+    delta = jnp.asarray(rng.standard_normal((n, w)).astype(np.float32))
+    table = jnp.zeros((v, w), jnp.float32)
+    got = ps.scatter_add_sorted_unique(table, ids, delta, interpret=False)
+    want = table.at[ids].add(delta, mode="drop")
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err < 1e-5, f"rmw mismatch {err}"
+
+
 RUNGS = [("vmem", rung_vmem), ("anyspace", rung_anyspace), ("dma", rung_dma),
          ("dyn_dma", rung_dyn_dma), ("prefetch", rung_prefetch),
-         ("loop_dma", rung_loop_dma)]
+         ("loop_dma", rung_loop_dma), ("rmw_scatter", rung_rmw_scatter)]
 
 
 def main():

@@ -199,6 +199,36 @@ def main():
                           "n=720k V=25M")
     os.environ.pop("DET_DEDUP_IMPL", None)
 
+    # Pallas RMW scatter kernel vs the flagged XLA scatter — only if this
+    # toolchain can compile it (see tools/tpu_mosaic_probe.py)
+    try:
+        from distributed_embeddings_tpu.ops import pallas_scatter as ps
+        n_u = 655_360                       # unique sorted rows
+        uniq2 = jnp.asarray(unique_sorted_ids(rng, n_u, v).astype(np.int32))
+        deltas = jnp.asarray(
+            rng.standard_normal((n_u, 16), dtype=np.float32))
+        # correctness first at a small shape, compiled
+        small_ids = jnp.asarray(
+            np.sort(rng.choice(10_000, 512, replace=False)).astype(np.int32))
+        small_d = jnp.asarray(
+            rng.standard_normal((512, 16), dtype=np.float32))
+        small_t = jnp.zeros((10_000, 16), jnp.float32)
+        got = ps.scatter_add_sorted_unique(small_t, small_ids, small_d,
+                                           interpret=False)
+        want = small_t.at[small_ids].add(small_d, mode="drop")
+        assert float(jnp.max(jnp.abs(got - want))) < 1e-5
+
+        def step_rmw(s):
+            t, d = s
+            t = ps.scatter_add_sorted_unique(t, uniq2, d, interpret=False)
+            return t, d + t[0, :1] * 0
+
+        timed_chain(step_rmw, (tbl, deltas), iters=6,
+                    label=f"pallas_rmw_scatter n={n_u} V=25M w=16")
+    except Exception as e:  # noqa: BLE001 - toolchain may reject the kernel
+        RESULTS["pallas_rmw_scatter"] = f"FAIL {str(e)[:200]}"
+        print(f"pallas_rmw_scatter: FAIL {str(e)[:300]}", flush=True)
+
     print(json.dumps(RESULTS), flush=True)
 
 
