@@ -521,6 +521,32 @@ def main():
                 record["tiny_ab_cumsum_error"] = str(e)[:200]
             finally:
                 os.environ.pop("DET_DEDUP_IMPL", None)
+            # fourth arm: Pallas RMW scatter for the row updates (gated on
+            # an eager hardware validation — compile failures just record)
+            try:
+                from distributed_embeddings_tpu.ops import sparse_update
+                record["tiny_ab_pallas_scatter_valid"] = (
+                    sparse_update.prevalidate_pallas_scatter())
+                if record["tiny_ab_pallas_scatter_valid"]:
+                    os.environ["DET_SCATTER_IMPL"] = "pallas"
+                    dt_ps = run_at_batch(
+                        SyntheticModel(cfg, mesh=None, distributed=True),
+                        batch)
+                    record["tiny_ab_pallas_scatter_ms"] = round(
+                        dt_ps * 1e3, 3)
+                    if dt_ps * 1e3 < record["value"]:
+                        record["value"] = round(dt_ps * 1e3, 3)
+                        record["vs_baseline"] = round(
+                            (batch / dt_ps) / baseline_throughput, 3)
+                        record["tiny_best_path"] = "pallas-rmw-scatter"
+                        if "tiny_roofline_step_ms" in record:
+                            record["tiny_roofline_frac"] = round(
+                                record["tiny_roofline_step_ms"]
+                                / record["value"], 3)
+            except Exception as e:  # noqa: BLE001
+                record["tiny_ab_pallas_scatter_error"] = str(e)[:200]
+            finally:
+                os.environ.pop("DET_SCATTER_IMPL", None)
         # secondary workload: DLRM samples/sec + HBM roofline (north-star
         # metric, BASELINE.json) — carried in the same single JSON line
         try:

@@ -66,6 +66,60 @@ def dedup_flags() -> dict:
             "indices_are_sorted": _dedup_impl() == "sort"}
 
 
+# --------------------------- Pallas RMW scatter dispatch (opt-in, validated)
+_PALLAS_SCATTER_OK = None     # None = unvalidated this process
+
+
+def prevalidate_pallas_scatter() -> bool:
+    """Eager compiled correctness check of the Pallas sorted-unique RMW
+    scatter kernel (ops/pallas_scatter.py) on this backend. Must run
+    OUTSIDE any jit trace; traced code consults the cached verdict and
+    falls back to XLA when unvalidated. Compile failures (the round-3
+    tunnel toolchain rejects every DMA kernel) count as not-validated."""
+    global _PALLAS_SCATTER_OK
+    if _PALLAS_SCATTER_OK is not None:
+        return _PALLAS_SCATTER_OK
+    import numpy as np
+    import warnings
+    try:
+        from distributed_embeddings_tpu.ops import pallas_scatter as ps
+        rng = np.random.RandomState(0)
+        v, w, n = 4096, 16, 512
+        ids = jnp.asarray(np.sort(rng.choice(v, n, replace=False))
+                          .astype(np.int32))
+        delta = jnp.asarray(rng.randn(n, w).astype(np.float32))
+        table = jnp.zeros((v, w), jnp.float32)
+        got = ps.scatter_add_sorted_unique(table, ids, delta,
+                                           interpret=False)
+        want = table.at[ids].add(delta, mode="drop")
+        ok = bool(jnp.max(jnp.abs(got - want)) < 1e-5)
+    except Exception as e:  # noqa: BLE001 - toolchain may reject the kernel
+        warnings.warn(f"DET_SCATTER_IMPL=pallas: kernel failed to "
+                      f"compile/run on this backend ({str(e)[:200]}); "
+                      "using XLA scatter")
+        ok = False
+    _PALLAS_SCATTER_OK = ok
+    return ok
+
+
+def _row_scatter_add(table: jax.Array, rep: jax.Array,
+                     delta: jax.Array) -> jax.Array:
+    """table[rep] += delta for dedup output (unique rep; OOB fillers carry
+    zero delta). Routes to the Pallas RMW kernel under
+    DET_SCATTER_IMPL=pallas when hardware-validated (prevalidate above);
+    default is the flagged XLA scatter."""
+    if (os.environ.get("DET_SCATTER_IMPL", "xla") == "pallas"
+            and jax.default_backend() == "tpu"):
+        use = (_PALLAS_SCATTER_OK if isinstance(table, jax.core.Tracer)
+               else prevalidate_pallas_scatter())
+        if use:
+            from distributed_embeddings_tpu.ops import pallas_scatter as ps
+            return ps.scatter_add_sorted_unique(
+                table, rep, delta.astype(table.dtype))
+    return table.at[rep].add(delta.astype(table.dtype), mode="drop",
+                             **dedup_flags())
+
+
 def take_rows(table: jax.Array, ids: jax.Array) -> jax.Array:
     """Row gather via raw lax.gather with PROMISE_IN_BOUNDS: emits no
     bounds-check constants, so it is legal inside `compute_on` host regions
@@ -213,15 +267,14 @@ def sparse_adagrad(table: jax.Array, accum: jax.Array, grad: SparseRowGrad,
     # measurement). dedup_flags() downgrades to unique-only under
     # DET_DEDUP_IMPL=cumsum
     fl = dedup_flags()
-    acc_new = accum.at[rep].add(sums * sums, mode="drop", **fl)
+    acc_new = _row_scatter_add(accum, rep, sums * sums)
     # gather with clamped index is safe: sentinel rows multiply a zero
     # update. Clamping collapses the dropped tail onto rows-1, so only the
     # sorted promise survives (and only under the sort impl)
     acc_rows = jnp.take(acc_new, jnp.minimum(rep, rows - 1), axis=0,
                         indices_are_sorted=fl["indices_are_sorted"])
     delta = -lr * sums * lax.rsqrt(acc_rows + eps)
-    return table.at[rep].add(delta.astype(table.dtype), mode="drop",
-                             **fl), acc_new
+    return _row_scatter_add(table, rep, delta), acc_new
 
 
 # ----------------------------------------------------------------- Adam

@@ -198,3 +198,22 @@ def test_concat_grads_and_jit():
     t3, a3 = su.sparse_adagrad(table, acc, g, 0.1, strategy="dense")
     np.testing.assert_allclose(np.asarray(t2), np.asarray(t3), rtol=2e-5,
                                atol=2e-5)
+
+
+def test_scatter_impl_pallas_ignored_off_tpu(monkeypatch):
+    """DET_SCATTER_IMPL=pallas must be inert off-TPU (CPU tests and CPU
+    meshes take the XLA scatter unconditionally)."""
+    monkeypatch.setenv("DET_SCATTER_IMPL", "pallas")
+    rng = np.random.default_rng(7)
+    ids, contribs, _ = make_case(rng, n=129)
+    table = rng.standard_normal((50, 8)).astype(np.float32)
+    g = su.SparseRowGrad(jnp.asarray(ids), jnp.asarray(contribs))
+    t1, a1 = su.sparse_adagrad(jnp.asarray(table),
+                               jnp.full((50, 8), 0.1, jnp.float32), g, 0.05,
+                               strategy="sort")
+    monkeypatch.delenv("DET_SCATTER_IMPL")
+    t2, a2 = su.sparse_adagrad(jnp.asarray(table),
+                               jnp.full((50, 8), 0.1, jnp.float32), g, 0.05,
+                               strategy="sort")
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
