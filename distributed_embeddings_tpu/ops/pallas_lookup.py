@@ -291,7 +291,8 @@ def _fused_impl(params, ids, weights, interpret):
     # narrow rows (< 1 lane) make per-row DMAs tiny; whether that still
     # beats XLA's gather is a hardware question — opt in via env until the
     # prims data answers it
-    narrow_ok = os.environ.get("DET_PALLAS_NARROW", "0") == "1"
+    narrow_ok = (os.environ.get("DET_PALLAS_NARROW", "0") == "1"
+                 and width in (8, 16, 32, 64))
     if narrow_ok and not _interpret_default(interpret):
         # under a jit trace the eager hardware check cannot run (it fetches
         # a compiled result); only a cached prevalidate_narrow verdict
@@ -301,7 +302,7 @@ def _fused_impl(params, ids, weights, interpret):
             narrow_ok = _NARROW_VALIDATED.get(key, False)
         else:
             narrow_ok = _narrow_path_ok(width, params.dtype)
-    use_narrow = narrow_ok and width in (8, 16, 32, 64)
+    use_narrow = narrow_ok
     if width % _LANE == 0 or use_narrow:
         return _dma_gather_lookup(params, ids, weights, interpret=interpret)
     # XLA fallback: gather + weighted reduce (still fused by XLA)

@@ -6,23 +6,28 @@ lowering: round-3 prims measured ~100-280 ns per scattered row against a
 row-wise optimizer update funnels through it. The reference hits the same
 op class with cub sort + a segment-reduce reusing its forward kernel
 (reference: cc/kernels/embedding_lookup_kernels.cu:603-775); the TPU answer
-is explicit DMA: after `dedup_sum` the update rows are UNIQUE AND SORTED,
-so a kernel can stream read-modify-write row DMAs with no conflict hazard
-and no atomics:
+is explicit DMA: after `dedup_sum` the update rows are UNIQUE, so a kernel
+can stream read-modify-write row DMAs with no conflict hazard and no
+atomics. Per grid step (one id tile, scalar-prefetched into SMEM):
 
-    for each id tile (scalar-prefetched into SMEM):
-        start row reads for tile t+1           (double-buffered)
-        wait reads of tile t, add delta rows   (VPU)
-        start row writes of tile t             (fire-and-forget until drain)
+    start + wait row reads of the tile        (tile_b copies in flight)
+    add the delta block                       (VPU)
+    start + wait row writes of the tile
 
-OOB ids (the dedup filler tail, id >= V) are redirected to a scratch dump
-row so the kernel stays branch-free; their deltas are zero by the dedup
-contract, and the dump row is scratch — nothing real is harmed.
+Tiles themselves overlap through the grid pipeline (the delta blocks of
+step i+1 stream in while step i runs); read/write overlap WITHIN a tile is
+deliberately not attempted until the compiled path exists on hardware —
+the r03 tunnel toolchain rejects every DMA kernel, so this kernel's first
+job is to be the minimal correct RMW stream for the mosaic probe to gate.
+
+OOB ids (the dedup filler tail, id >= V) issue no DMA at all — reads and
+writes are predicated per row, so no dump row, no table copy, and the
+table rides input_output_aliasing untouched except for the rows actually
+updated.
 
 Status: interpret-mode correct (tests/test_pallas_scatter.py); compiled
-use is gated on `tools/tpu_mosaic_probe.py` because the current tunnel
-toolchain crashes on every DMA-kernel compile (round3_notes). Wire-up into
-sparse_update is deliberately deferred until a hardware A/B exists.
+use is gated on `sparse_update.prevalidate_pallas_scatter()`. Dispatch
+lives in sparse_update._row_scatter_add behind DET_SCATTER_IMPL=pallas.
 """
 
 import functools
@@ -40,70 +45,70 @@ def _interpret_default(interpret: Optional[bool]) -> bool:
     return interpret
 
 
-# rows in flight per buffer; bounds VMEM (2 slots * 2 buffers * TILE * w * 4B)
+# rows per tile; bounds VMEM (tile * width * 4B for the row buffer) and the
+# number of concurrent row DMAs
 _TILE = 256
 
 
-def _scatter_kernel(ids_ref, delta_ref, table_ref, out_ref, rows_ref, sems,
-                    wsem, *, tile: int, width: int, vocab: int):
+def _scatter_kernel(ids_ref, delta_ref, table_ref, out_ref, rows_ref, rsem,
+                    wsem, *, tile: int, vocab: int):
     """Grid step i processes ids[i*tile : (i+1)*tile]. table_ref/out_ref are
     the SAME HBM buffer (input_output_aliasing), so reads see prior tiles'
     writes only across grid steps — safe because ids are globally unique."""
     i = pl.program_id(0)
     base = i * tile
 
-    def rd(j, slot):
+    def rd(j):
         row = ids_ref[base + j]
-        safe = jnp.where(row < vocab, row, vocab)     # dump row for fillers
         return pltpu.make_async_copy(
-            table_ref.at[safe], rows_ref.at[slot, j], sems.at[slot, j])
+            table_ref.at[row], rows_ref.at[j], rsem.at[j])
 
-    def wr(j, slot):
+    def wr(j):
         row = ids_ref[base + j]
-        safe = jnp.where(row < vocab, row, vocab)
         return pltpu.make_async_copy(
-            rows_ref.at[slot, j], out_ref.at[safe], wsem.at[slot, j])
+            rows_ref.at[j], out_ref.at[row], wsem.at[j])
 
-    def start_reads(slot):
-        jax.lax.fori_loop(0, tile, lambda j, _: (rd(j, slot).start(), 0)[1],
-                          0)
+    def issue(j, fn):
+        # fillers (id >= vocab) issue no DMA: nothing read, nothing written
+        @pl.when(ids_ref[base + j] < vocab)
+        def _():
+            fn(j)
 
-    def wait_reads(slot):
-        jax.lax.fori_loop(0, tile, lambda j, _: (rd(j, slot).wait(), 0)[1],
-                          0)
-
-    # one grid step = one tile; the pipeline across tiles is the grid itself
-    start_reads(0)
-    wait_reads(0)
-    rows_ref[0] = rows_ref[0] + delta_ref[:].astype(rows_ref.dtype)
-    jax.lax.fori_loop(0, tile, lambda j, _: (wr(j, 0).start(), 0)[1], 0)
-    jax.lax.fori_loop(0, tile, lambda j, _: (wr(j, 0).wait(), 0)[1], 0)
+    jax.lax.fori_loop(0, tile,
+                      lambda j, _: (issue(j, lambda k: rd(k).start()), 0)[1],
+                      0)
+    jax.lax.fori_loop(0, tile,
+                      lambda j, _: (issue(j, lambda k: rd(k).wait()), 0)[1],
+                      0)
+    rows_ref[:] = rows_ref[:] + delta_ref[:].astype(rows_ref.dtype)
+    jax.lax.fori_loop(0, tile,
+                      lambda j, _: (issue(j, lambda k: wr(k).start()), 0)[1],
+                      0)
+    jax.lax.fori_loop(0, tile,
+                      lambda j, _: (issue(j, lambda k: wr(k).wait()), 0)[1],
+                      0)
 
 
 def scatter_add_sorted_unique(table: jax.Array, ids: jax.Array,
                               delta: jax.Array,
                               interpret: Optional[bool] = None) -> jax.Array:
-    """table[ids[k]] += delta[k] for SORTED UNIQUE ids; ids >= V are dropped
-    (dedup filler contract — their deltas must be zero). Returns the updated
-    table; donate `table` for a true in-place update.
-
-    The table travels through input_output_aliasing, so HBM traffic is the
-    touched rows only (read + write), not a table copy.
+    """table[ids[k]] += delta[k] for UNIQUE ids (sorted preferred for HBM
+    locality); ids >= V are dropped (dedup filler contract). Returns the
+    updated table; donate `table` for a true in-place update — the table
+    travels through input_output_aliasing, so HBM traffic is the touched
+    rows only (read + write), not a table copy.
     """
     vocab, width = table.shape
     n = ids.shape[0]
     tile = min(_TILE, n)
     pad = -n % tile
     if pad:
-        # filler ids (>= vocab) with zero deltas — dropped by the dump row
+        # filler ids (>= vocab) — predicated out inside the kernel
         ids = jnp.concatenate(
             [ids, jnp.full((pad,), vocab, ids.dtype)])
         delta = jnp.concatenate(
             [delta, jnp.zeros((pad, width), delta.dtype)], axis=0)
         n += pad
-    # +1 dump row absorbs filler reads/writes harmlessly
-    table_x = jnp.concatenate(
-        [table, jnp.zeros((1, width), table.dtype)], axis=0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -115,17 +120,15 @@ def scatter_add_sorted_unique(table: jax.Array, ids: jax.Array,
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
         scratch_shapes=[
-            pltpu.VMEM((1, tile, width), table.dtype),
-            pltpu.SemaphoreType.DMA((1, tile)),
-            pltpu.SemaphoreType.DMA((1, tile)),
+            pltpu.VMEM((tile, width), table.dtype),
+            pltpu.SemaphoreType.DMA((tile,)),
+            pltpu.SemaphoreType.DMA((tile,)),
         ],
     )
-    out = pl.pallas_call(
-        functools.partial(_scatter_kernel, tile=tile, width=width,
-                          vocab=vocab),
+    return pl.pallas_call(
+        functools.partial(_scatter_kernel, tile=tile, vocab=vocab),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(table_x.shape, table.dtype),
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
         input_output_aliases={2: 0},   # table (input 2 incl. prefetch) -> out
         interpret=_interpret_default(interpret),
-    )(ids.astype(jnp.int32), delta, table_x)
-    return out[:vocab]
+    )(ids.astype(jnp.int32), delta, table)

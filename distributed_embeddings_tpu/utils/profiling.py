@@ -34,17 +34,24 @@ def fetch_sync(out) -> float:
     reduced from the outputs cannot complete before the data exists, so a
     fetch is the sync of record for all timing in this repo.
 
-    Device-side cost is one reduction per leaf fused into one tiny transfer
-    each; returns the summed scalar so callers can finite-check it.
+    Cost per leaf is one element's slice + host fetch — NOT a full-leaf
+    reduction (an astype/sum would materialize an f32 copy of every leaf:
+    for a 4 GiB bf16 table that is an 8 GiB temp inside the timed region).
+    A one-element slice carries the same guarantee: it cannot be produced
+    before the leaf's buffer exists. Returns the summed scalar so callers
+    can sanity-check it (note: only element [0...] of each leaf is
+    observed — use a full device-side reduction if you need finiteness of
+    the whole output).
     """
     import jax.numpy as jnp
     total = 0.0
     for leaf in jax.tree.leaves(out):
         if not hasattr(leaf, "dtype"):
             continue
-        if jnp.issubdtype(leaf.dtype, jnp.bool_):
+        if jnp.issubdtype(leaf.dtype, jnp.bool_) or leaf.size == 0:
             continue
-        total += float(jnp.sum(leaf.astype(jnp.float32)))
+        first = leaf.reshape(-1)[0] if leaf.ndim else leaf
+        total += float(first.astype(jnp.float32))
     return total
 
 

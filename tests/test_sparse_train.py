@@ -308,16 +308,17 @@ def test_sparse_step_hlo_scatter_promises(monkeypatch):
             return (loss, res) if return_residuals else loss
 
     def lower_text():
-        # big-vocab single bucket so the auto strategy takes the sort path
+        # big-vocab single bucket so the auto strategy takes the sort path;
+        # abstract avals only — lowering needs shapes, not a 1 GiB table
         emb = DistributedEmbedding([Embedding(30_000_000, 8)], mesh=None)
         model = _Tiny(emb)
         init_fn, step_fn = make_sparse_train_step(model, "adagrad", lr=0.01)
-        params = {"embedding": emb.init(jax.random.PRNGKey(0))}
-        state = init_fn(params)
-        rng = np.random.RandomState(0)
-        num = jnp.zeros((8, 1), jnp.float32)
-        cats = [jnp.asarray(rng.randint(0, 30_000_000, (8,)).astype(np.int32))]
-        lab = jnp.zeros((8,), jnp.float32)
+        params = jax.eval_shape(
+            lambda: {"embedding": emb.init(jax.random.PRNGKey(0))})
+        state = jax.eval_shape(init_fn, params)
+        num = jax.ShapeDtypeStruct((8, 1), jnp.float32)
+        cats = [jax.ShapeDtypeStruct((8,), jnp.int32)]
+        lab = jax.ShapeDtypeStruct((8,), jnp.float32)
         return jax.jit(step_fn).lower(params, state, num, cats, lab).as_text()
 
     monkeypatch.setenv("DET_DEDUP_IMPL", "sort")

@@ -30,6 +30,7 @@ print('fetch', float(jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128)))))
 " >> "$LOG" 2>&1; then
     echo "$(date +%H:%M:%S) probe $n SUCCESS — tunnel alive" >> "$LOG"
     touch /tmp/tpu_alive_r03c
+    bench_rc=1
     for stage in "tools/tpu_mosaic_probe.py:900:mosaic" \
                  "tools/tpu_scatter_probe.py:2700:scatter" \
                  "tools/tpu_pallas_check.py --quick:2700:pallas" \
@@ -39,11 +40,20 @@ print('fetch', float(jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128)))))
       # shellcheck disable=SC2086
       timeout "$secs" python -u $cmd \
         > "tools/watch_${name}_r03c.out" 2>&1
-      echo "$(date +%H:%M:%S) $name rc=$?" >> "$LOG"
+      rc=$?
+      echo "$(date +%H:%M:%S) $name rc=$rc" >> "$LOG"
+      [ "$name" = bench ] && bench_rc=$rc
       sleep 20
     done
-    touch /tmp/tpu_measured_r03c
-    exit 0
+    # success sentinel only when the headline measurement actually landed
+    # (a fresh one, not the cached-record fallback)
+    if [ "$bench_rc" -eq 0 ] \
+       && grep -q '"metric"' tools/watch_bench_r03c.out \
+       && ! grep -q '"cached": true' tools/watch_bench_r03c.out; then
+      touch /tmp/tpu_measured_r03c
+      exit 0
+    fi
+    echo "$(date +%H:%M:%S) measurement did not land; resuming watch" >> "$LOG"
   else
     echo "$(date +%H:%M:%S) probe $n failed" >> "$LOG"
   fi
