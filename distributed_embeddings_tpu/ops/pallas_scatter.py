@@ -132,3 +132,111 @@ def scatter_add_sorted_unique(table: jax.Array, ids: jax.Array,
         input_output_aliases={2: 0},   # table (input 2 incl. prefetch) -> out
         interpret=_interpret_default(interpret),
     )(ids.astype(jnp.int32), delta, table)
+
+
+# ---------------------------------------------------------------------------
+# fused row-wise adagrad: one RMW stream updates table AND accumulator
+# ---------------------------------------------------------------------------
+def _adagrad_kernel(ids_ref, sums_ref, table_ref, acc_ref, out_t, out_a,
+                    trows, arows, tr_sem, ar_sem, tw_sem, aw_sem,
+                    *, tile: int, vocab: int, lr: float, eps: float):
+    i = pl.program_id(0)
+    base = i * tile
+
+    def rd_t(j):
+        return pltpu.make_async_copy(table_ref.at[ids_ref[base + j]],
+                                     trows.at[j], tr_sem.at[j])
+
+    def rd_a(j):
+        return pltpu.make_async_copy(acc_ref.at[ids_ref[base + j]],
+                                     arows.at[j], ar_sem.at[j])
+
+    def wr_t(j):
+        return pltpu.make_async_copy(trows.at[j],
+                                     out_t.at[ids_ref[base + j]],
+                                     tw_sem.at[j])
+
+    def wr_a(j):
+        return pltpu.make_async_copy(arows.at[j],
+                                     out_a.at[ids_ref[base + j]],
+                                     aw_sem.at[j])
+
+    def guarded(j, fn):
+        @pl.when(ids_ref[base + j] < vocab)
+        def _():
+            fn(j)
+
+    def loop(fn):
+        jax.lax.fori_loop(0, tile,
+                          lambda j, _: (guarded(j, fn), 0)[1], 0)
+
+    loop(lambda j: rd_t(j).start())
+    loop(lambda j: rd_a(j).start())
+    loop(lambda j: rd_t(j).wait())
+    loop(lambda j: rd_a(j).wait())
+
+    s = sums_ref[:].astype(jnp.float32)
+    acc_new = arows[:].astype(jnp.float32) + s * s
+    delta = (-lr) * s * jax.lax.rsqrt(acc_new + eps)
+    arows[:] = acc_new.astype(arows.dtype)
+    trows[:] = (trows[:].astype(jnp.float32) + delta).astype(trows.dtype)
+
+    loop(lambda j: wr_t(j).start())
+    loop(lambda j: wr_a(j).start())
+    loop(lambda j: wr_t(j).wait())
+    loop(lambda j: wr_a(j).wait())
+
+
+def adagrad_rows_sorted_unique(table: jax.Array, accum: jax.Array,
+                               ids: jax.Array, sums: jax.Array, lr: float,
+                               eps: float = 1e-10,
+                               interpret: Optional[bool] = None):
+    """Fused sparse adagrad on UNIQUE rows (dedup_sum output):
+
+        acc[r]   += sums_r^2
+        table[r] -= lr * sums_r * rsqrt(acc[r] + eps)
+
+    in ONE read-modify-write stream per row pair — the XLA formulation
+    costs two scatters plus a gather of the same rows (the dominant cost
+    at 100-280 ns/row, round-3 prims). ids >= V are skipped; their sums
+    must be zero. Returns (table', accum'), both alias their inputs.
+    """
+    vocab, width = table.shape
+    n = ids.shape[0]
+    tile = min(_TILE, n)
+    pad = -n % tile
+    if pad:
+        ids = jnp.concatenate([ids, jnp.full((pad,), vocab, ids.dtype)])
+        sums = jnp.concatenate(
+            [sums, jnp.zeros((pad, width), sums.dtype)], axis=0)
+        n += pad
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, width), lambda i, ids_ref: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),      # table
+            pl.BlockSpec(memory_space=pltpu.ANY),      # accumulator
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                   pl.BlockSpec(memory_space=pltpu.ANY)],
+        scratch_shapes=[
+            pltpu.VMEM((tile, width), table.dtype),
+            pltpu.VMEM((tile, width), accum.dtype),
+            pltpu.SemaphoreType.DMA((tile,)),
+            pltpu.SemaphoreType.DMA((tile,)),
+            pltpu.SemaphoreType.DMA((tile,)),
+            pltpu.SemaphoreType.DMA((tile,)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_adagrad_kernel, tile=tile, vocab=vocab,
+                          lr=float(lr), eps=float(eps)),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(table.shape, table.dtype),
+                   jax.ShapeDtypeStruct(accum.shape, accum.dtype)],
+        input_output_aliases={2: 0, 3: 1},   # table->out_t, acc->out_a
+        interpret=_interpret_default(interpret),
+    )(ids.astype(jnp.int32), sums, table, accum)

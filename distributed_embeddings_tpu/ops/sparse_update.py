@@ -93,6 +93,16 @@ def prevalidate_pallas_scatter() -> bool:
                                            interpret=False)
         want = table.at[ids].add(delta, mode="drop")
         ok = bool(jnp.max(jnp.abs(got - want)) < 1e-5)
+        # the fused adagrad kernel rides the same gate
+        acc = jnp.full((v, w), 0.1, jnp.float32)
+        t2, a2 = ps.adagrad_rows_sorted_unique(table, acc, ids, delta, 0.05,
+                                               interpret=False)
+        a_want = acc.at[ids].add(delta * delta, mode="drop")
+        d_want = -0.05 * delta * lax.rsqrt(
+            jnp.take(a_want, ids, axis=0) + 1e-10)
+        t_want = table.at[ids].add(d_want, mode="drop")
+        ok = (ok and bool(jnp.max(jnp.abs(a2 - a_want)) < 1e-5)
+              and bool(jnp.max(jnp.abs(t2 - t_want)) < 1e-5))
     except Exception as e:  # noqa: BLE001 - toolchain may reject the kernel
         warnings.warn(f"DET_SCATTER_IMPL=pallas: kernel failed to "
                       f"compile/run on this backend ({str(e)[:200]}); "
@@ -102,20 +112,28 @@ def prevalidate_pallas_scatter() -> bool:
     return ok
 
 
+def _use_pallas_scatter(ref_array) -> bool:
+    """True when DET_SCATTER_IMPL=pallas is active, the backend is TPU, and
+    the kernels validated on this chip (eager prevalidate required before
+    traced use)."""
+    if (os.environ.get("DET_SCATTER_IMPL", "xla") != "pallas"
+            or jax.default_backend() != "tpu"):
+        return False
+    if isinstance(ref_array, jax.core.Tracer):
+        return bool(_PALLAS_SCATTER_OK)
+    return prevalidate_pallas_scatter()
+
+
 def _row_scatter_add(table: jax.Array, rep: jax.Array,
                      delta: jax.Array) -> jax.Array:
     """table[rep] += delta for dedup output (unique rep; OOB fillers carry
     zero delta). Routes to the Pallas RMW kernel under
     DET_SCATTER_IMPL=pallas when hardware-validated (prevalidate above);
     default is the flagged XLA scatter."""
-    if (os.environ.get("DET_SCATTER_IMPL", "xla") == "pallas"
-            and jax.default_backend() == "tpu"):
-        use = (_PALLAS_SCATTER_OK if isinstance(table, jax.core.Tracer)
-               else prevalidate_pallas_scatter())
-        if use:
-            from distributed_embeddings_tpu.ops import pallas_scatter as ps
-            return ps.scatter_add_sorted_unique(
-                table, rep, delta.astype(table.dtype))
+    if _use_pallas_scatter(table):
+        from distributed_embeddings_tpu.ops import pallas_scatter as ps
+        return ps.scatter_add_sorted_unique(
+            table, rep, delta.astype(table.dtype))
     return table.at[rep].add(delta.astype(table.dtype), mode="drop",
                              **dedup_flags())
 
@@ -261,6 +279,12 @@ def sparse_adagrad(table: jax.Array, accum: jax.Array, grad: SparseRowGrad,
                         -lr * g * lax.rsqrt(acc_new + eps), 0.0)
         return table + upd.astype(table.dtype), acc_new
     rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows)
+    if _use_pallas_scatter(table):
+        # fused RMW stream: one pass reads+updates table and accumulator
+        # rows together (vs two scatters + a gather of the same rows)
+        from distributed_embeddings_tpu.ops import pallas_scatter as ps
+        return ps.adagrad_rows_sorted_unique(table, accum, rep, sums, lr,
+                                             eps)
     # rep is strictly increasing under the default impl (dedup_sum
     # contract) => both scatter promises hold; without them XLA's
     # duplicate-safe lowering costs ~100-280 ns/row on TPU (round-3 prims

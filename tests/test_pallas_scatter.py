@@ -72,3 +72,30 @@ def test_scatter_add_under_jit():
         jnp.asarray(delta), mode="drop")
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("v,w,n_real,n_total", [
+    (500, 8, 100, 128), (800, 16, 512, 512),
+])
+def test_adagrad_rows_fused_matches_formula(v, w, n_real, n_total):
+    """Fused adagrad RMW kernel == the row-wise adagrad formula on unique
+    rows, with untouched rows (and OOB fillers) left intact."""
+    rng = np.random.default_rng(v)
+    ids = make_sorted_unique(rng, n_real, v, n_total)
+    sums = np.zeros((n_total, w), np.float32)
+    sums[:n_real] = rng.standard_normal((n_real, w))
+    table = rng.standard_normal((v, w)).astype(np.float32)
+    acc = np.full((v, w), 0.1, np.float32)
+    lr, eps = 0.05, 1e-10
+
+    t2, a2 = ps.adagrad_rows_sorted_unique(
+        jnp.asarray(table), jnp.asarray(acc), jnp.asarray(ids),
+        jnp.asarray(sums), lr, eps)
+
+    want_t, want_a = table.copy(), acc.copy()
+    for k in range(n_real):
+        r = ids[k]
+        want_a[r] = acc[r] + sums[k] * sums[k]
+        want_t[r] = table[r] - lr * sums[k] / np.sqrt(want_a[r] + eps)
+    np.testing.assert_allclose(np.asarray(a2), want_a, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(t2), want_t, rtol=1e-5, atol=1e-5)
