@@ -256,6 +256,25 @@ def run_dlrm_bench(batches=(65536, 32768, 16384), iters=20):
                 extra["dlrm_ab_error"] = str(e)[:200]
             finally:
                 os.environ.pop("DET_DEDUP_IMPL", None)
+            # strategy A/B: dense aggregation beat sort 2.1x in the round-3
+            # prims (pre-promise-flags); the criteo bucket (333M elems)
+            # auto-picks sort, so measure dense explicitly
+            try:
+                # _pick reads the env per trace, no reload needed
+                os.environ["DET_SPARSE_DENSE_MAX"] = str(500 * 1024 * 1024)
+                dt_dn = run_at_batch(
+                    SyntheticModel(cfg, mesh=None, distributed=True), batch,
+                    iters=iters)
+                extra["dlrm_ab_dense_ms"] = round(dt_dn * 1e3, 3)
+                if dt_dn < dt:
+                    dt = dt_dn
+                    extra["dlrm_strategy"] = "dense"
+                    extra["dlrm_timing_raw"] = getattr(
+                        run_at_batch, "last_raw", None)
+            except Exception as e:  # noqa: BLE001
+                extra["dlrm_ab_dense_error"] = str(e)[:200]
+            finally:
+                os.environ.pop("DET_SPARSE_DENSE_MAX", None)
         dev = jax.devices()[0]
         gen = _chip_gen(dev)
         widths, hot = [], []

@@ -249,7 +249,10 @@ def _dense_sum(ids, contribs, rows):
 def _pick(strategy: str, rows: int, width: int) -> str:
     if strategy != "auto":
         return strategy
-    return "dense" if rows * width <= DENSE_ELEMS_MAX else "sort"
+    # env read per call (not at import): lets the bench A/B strategies by
+    # re-tracing with a different DET_SPARSE_DENSE_MAX
+    mx = int(os.environ.get("DET_SPARSE_DENSE_MAX", DENSE_ELEMS_MAX))
+    return "dense" if rows * width <= mx else "sort"
 
 
 # ------------------------------------------------------------------ SGD

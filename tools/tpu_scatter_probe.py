@@ -225,6 +225,15 @@ def main():
 
         timed_chain(step_rmw, (tbl, deltas), iters=6,
                     label=f"pallas_rmw_scatter n={n_u} V=25M w=16")
+
+        def step_fused(s):
+            t, a, d = s
+            t, a = ps.adagrad_rows_sorted_unique(t, a, uniq2, d, 0.01,
+                                                 interpret=False)
+            return t, a, d + t[0, :1] * 0
+
+        timed_chain(step_fused, (tbl, acc, deltas), iters=6,
+                    label=f"pallas_fused_adagrad n={n_u} V=25M w=16")
     except Exception as e:  # noqa: BLE001 - toolchain may reject the kernel
         RESULTS["pallas_rmw_scatter"] = f"FAIL {str(e)[:200]}"
         print(f"pallas_rmw_scatter: FAIL {str(e)[:300]}", flush=True)
