@@ -1,0 +1,54 @@
+"""Timing utilities: fetch_sync contract + slope-based chained timing.
+
+These became load-bearing in round 3: on the axon TPU tunnel,
+block_until_ready returns early and unfetched work may never execute
+(docs/round3_notes.md), so every benchmark in the repo routes through
+fetch_sync / benchmark_chained. The tests pin the API contract on CPU.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from distributed_embeddings_tpu.utils import profiling
+
+
+def test_fetch_sync_handles_leaf_zoo():
+    out = {
+        "f32": jnp.ones((4, 4)),
+        "bf16": jnp.ones((2,), jnp.bfloat16),
+        "int": jnp.arange(3),
+        "bool": jnp.ones((2,), bool),          # skipped
+        "empty": jnp.zeros((0, 8)),            # skipped
+        "scalar": jnp.float32(2.5),
+        "none": None,                          # not an array leaf
+    }
+    total = profiling.fetch_sync(out)
+    # 1.0 (f32[0]) + 1.0 (bf16[0]) + 0 (int[0]) + 2.5 (scalar)
+    assert abs(total - 4.5) < 1e-6
+
+
+def test_benchmark_chained_measures_real_work():
+    def step(s):
+        x, acc = s
+        y = x @ x
+        return y / (jnp.max(jnp.abs(y)) + 1.0), acc + y[0, 0]
+
+    x = jnp.asarray(np.random.RandomState(0).randn(128, 128),
+                    dtype=jnp.float32)
+    res = profiling.benchmark_chained(step, (x, jnp.float32(0)), iters=4)
+    assert res.mean_s > 0
+    assert res.compile_s > res.mean_s          # compile dominates tiny work
+    assert np.isfinite(res.mean_s)
+
+
+def test_benchmark_fetches_each_iteration():
+    calls = []
+
+    def fn(x):
+        calls.append(1)
+        return x + 1.0
+
+    res = profiling.benchmark(fn, jnp.zeros((2, 2)), iters=3, warmup=1)
+    assert len(calls) == 1 + 1 + 3             # compile + warmup + iters
+    assert res.iters == 3 and res.min_s > 0
