@@ -312,6 +312,19 @@ def supervise() -> int:
     back.
     """
     import subprocess
+    # the claim watcher holds /tmp/det_tpu_busy while its own serialized
+    # measurement stages run; two processes fighting over the single chip
+    # claim is how the tunnel wedges, so wait (bounded) for it to clear.
+    # The watcher's own bench stage skips this via DET_BENCH_SKIP_BUSY_WAIT.
+    if os.environ.get("DET_BENCH_SKIP_BUSY_WAIT") != "1":
+        waited = 0.0
+        while os.path.exists("/tmp/det_tpu_busy") and waited < float(
+                os.environ.get("DET_BENCH_BUSY_WAIT_S", 1800)):
+            if waited == 0:
+                print("waiting for claim-watcher stages to finish "
+                      "(/tmp/det_tpu_busy)", file=sys.stderr, flush=True)
+            time.sleep(15)
+            waited += 15
     attempts = int(os.environ.get("DET_BENCH_ATTEMPTS", 3))
     per_try_s = float(os.environ.get("DET_BENCH_TRY_TIMEOUT_S", 3300))
     backoff_s = float(os.environ.get("DET_BENCH_BACKOFF_S", 120))

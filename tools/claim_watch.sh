@@ -11,12 +11,21 @@
 # Logs: /root/repo/tools/claim_watch_r03c.log  Sentinel: /tmp/tpu_alive_r03c
 set -u
 LOG=/root/repo/tools/claim_watch_r03c.log
+BUSY=/tmp/det_tpu_busy
+# hard deadline: stop probing well before the driver's round-end bench so
+# the two never fight over the single chip claim (driver deadline ~15:44)
+DEADLINE_EPOCH=${DET_WATCH_DEADLINE:-$(date -d "2026-07-31 14:15 UTC" +%s)}
 cd /root/repo
 export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache_det_tpu
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
 echo "$(date +%H:%M:%S) watcher start (phase 2)" >> "$LOG"
 n=0
 while true; do
+  if [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; then
+    echo "$(date +%H:%M:%S) deadline reached; watcher exits" >> "$LOG"
+    rm -f "$BUSY"
+    exit 0
+  fi
   n=$((n+1))
   # the probe must see a real accelerator: JAX can silently fall back to
   # the CPU backend (exit 0, [CpuDevice(0)]) — that is NOT a live tunnel
@@ -31,20 +40,27 @@ print('fetch', float(jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128)))))
     echo "$(date +%H:%M:%S) probe $n SUCCESS — tunnel alive" >> "$LOG"
     touch /tmp/tpu_alive_r03c
     bench_rc=1
+    touch "$BUSY"    # bench.py's supervisor waits on this (driver collision)
+    trap 'rm -f "$BUSY"' EXIT
     for stage in "tools/tpu_mosaic_probe.py:900:mosaic" \
                  "tools/tpu_scatter_probe.py:2700:scatter" \
                  "tools/tpu_pallas_check.py --quick:2700:pallas" \
                  "bench.py:7200:bench"; do
+      if [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; then
+        echo "$(date +%H:%M:%S) deadline mid-stages; stopping" >> "$LOG"
+        break
+      fi
       cmd=${stage%%:*}; rest=${stage#*:}; secs=${rest%%:*}; name=${rest#*:}
       echo "$(date +%H:%M:%S) running $name" >> "$LOG"
       # shellcheck disable=SC2086
-      timeout "$secs" python -u $cmd \
+      DET_BENCH_SKIP_BUSY_WAIT=1 timeout "$secs" python -u $cmd \
         > "tools/watch_${name}_r03c.out" 2>&1
       rc=$?
       echo "$(date +%H:%M:%S) $name rc=$rc" >> "$LOG"
       [ "$name" = bench ] && bench_rc=$rc
       sleep 20
     done
+    rm -f "$BUSY"
     # success sentinel only when the headline measurement actually landed
     # (a fresh one, not the cached-record fallback)
     if [ "$bench_rc" -eq 0 ] \
