@@ -258,7 +258,17 @@ def _pick(strategy: str, rows: int, width: int) -> str:
 # ------------------------------------------------------------------ SGD
 def sparse_sgd(table: jax.Array, grad: SparseRowGrad, lr) -> jax.Array:
     """table[ids] -= lr * contribs. Duplicates need no aggregation (add is
-    associative); OOB/padded ids are dropped by the scatter."""
+    associative); OOB/padded ids are dropped by the scatter.
+
+    DET_SGD_DEDUP=1 aggregates first: the raw-duplicate scatter can make
+    no promises to XLA (round-3 prims: 106 ns/row duplicate-safe lowering)
+    while the deduped scatter is unique(+sorted) and Pallas-eligible —
+    whether sort+aggregate+promised-scatter beats one raw scatter is a
+    hardware question, hence opt-in."""
+    if os.environ.get("DET_SGD_DEDUP", "0") == "1":
+        rep, sums = dedup_sum(grad.ids, grad.contribs,
+                              sentinel=table.shape[0])
+        return _row_scatter_add(table, rep, -lr * sums)
     return table.at[grad.ids].add(
         (-lr * grad.contribs.astype(jnp.float32)).astype(table.dtype),
         mode="drop")
