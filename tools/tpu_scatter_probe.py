@@ -114,15 +114,17 @@ def main():
 
         # composite: sort + sorted-gather + inverse-permute vs the raw
         # unsorted gather above — the end-to-end decision for a
-        # sorted-lookup forward path
+        # sorted-lookup forward path. Inverse permute is SCATTER-FREE
+        # (argsort + take): an .at[perm].set would reintroduce the
+        # 106 ns/row scatter this path exists to avoid
         def composite(s):
             t, i = s
             iota = jnp.arange(i.shape[0], dtype=jnp.int32)
             sid, perm = lax.sort_key_val(i, iota)
+            inv = lax.sort_key_val(perm, iota)[1]
             rows_srt = jnp.take(t, sid, axis=0, mode="clip",
                                 indices_are_sorted=True)
-            out = jnp.zeros_like(rows_srt).at[perm].set(
-                rows_srt, unique_indices=True)
+            out = jnp.take(rows_srt, inv, axis=0)
             return t, (i + out[0, 0].astype(jnp.int32) % 2)
 
         timed_chain(composite, (table, dup_ids),
