@@ -63,6 +63,11 @@ print('fetch', float(jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128)))))
     rm -f "$BUSY"
     # success sentinel only when the headline measurement actually landed
     # (a fresh one, not the cached-record fallback)
+    # timestamp whatever landed (even partial stages are evidence)
+    git add tools/watch_*_r03c.out tools/bench_last_tpu.json \
+        tools/claim_watch_r03c.log 2>/dev/null \
+      && git commit -q -m "Hardware window artifacts (claim watcher)" \
+        2>/dev/null || true
     if [ "$bench_rc" -eq 0 ] \
        && grep -q '"metric"' tools/watch_bench_r03c.out \
        && ! grep -q '"cached": true' tools/watch_bench_r03c.out; then
