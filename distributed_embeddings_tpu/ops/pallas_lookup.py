@@ -40,7 +40,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # Vocab size at or below which the MXU one-hot-matmul kernel is used.
-ONEHOT_MAX_VOCAB = 8192
+# Default pending hardware re-measurement (round-3: the first A/B's timings
+# were invalidated by the axon sync bug; the fixed slope-timed pallas check
+# re-measures next window). DET_ONEHOT_MAX_VOCAB overrides for A/B; 0
+# disables the MXU kernel entirely.
+import os as _os
+
+ONEHOT_MAX_VOCAB = int(_os.environ.get("DET_ONEHOT_MAX_VOCAB", 8192))
 # The DMA kernel wants lane-aligned rows; others fall back to XLA.
 _LANE = 128
 
