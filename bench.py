@@ -317,9 +317,19 @@ def supervise() -> int:
     # claim is how the tunnel wedges, so wait (bounded) for it to clear.
     # The watcher's own bench stage skips this via DET_BENCH_SKIP_BUSY_WAIT.
     if os.environ.get("DET_BENCH_SKIP_BUSY_WAIT") != "1":
+        def _busy_holder_alive():
+            """The lock file carries the watcher's pid; a stale lock (dead
+            holder, e.g. SIGKILLed watcher skipping its EXIT trap) must not
+            delay the bench."""
+            try:
+                with open("/tmp/det_tpu_busy") as f:
+                    pid = int(f.read().strip() or "0")
+                return pid > 0 and os.path.exists(f"/proc/{pid}")
+            except (OSError, ValueError):
+                return False
         waited = 0.0
-        while os.path.exists("/tmp/det_tpu_busy") and waited < float(
-                os.environ.get("DET_BENCH_BUSY_WAIT_S", 1800)):
+        while _busy_holder_alive() and waited < float(
+                os.environ.get("DET_BENCH_BUSY_WAIT_S", 3600)):
             if waited == 0:
                 print("waiting for claim-watcher stages to finish "
                       "(/tmp/det_tpu_busy)", file=sys.stderr, flush=True)

@@ -42,11 +42,15 @@ from jax.experimental.pallas import tpu as pltpu
 # Vocab size at or below which the MXU one-hot-matmul kernel is used.
 # Default pending hardware re-measurement (round-3: the first A/B's timings
 # were invalidated by the axon sync bug; the fixed slope-timed pallas check
-# re-measures next window). DET_ONEHOT_MAX_VOCAB overrides for A/B; 0
-# disables the MXU kernel entirely.
-import os as _os
+# re-measures next window). DET_ONEHOT_MAX_VOCAB overrides per trace (read
+# per call like DET_SPARSE_DENSE_MAX, so in-process A/B works); 0 disables
+# the MXU kernel entirely.
+ONEHOT_MAX_VOCAB = 8192
 
-ONEHOT_MAX_VOCAB = int(_os.environ.get("DET_ONEHOT_MAX_VOCAB", 8192))
+
+def _onehot_max_vocab() -> int:
+    import os
+    return int(os.environ.get("DET_ONEHOT_MAX_VOCAB", ONEHOT_MAX_VOCAB))
 # The DMA kernel wants lane-aligned rows; others fall back to XLA.
 _LANE = 128
 
@@ -251,7 +255,7 @@ def _narrow_path_ok(width: int, dtype) -> bool:
         return _NARROW_VALIDATED[key]
     import warnings
     rng = np.random.RandomState(width)
-    vocab = ONEHOT_MAX_VOCAB + 64
+    vocab = _onehot_max_vocab() + 64
     table = jnp.asarray(rng.randn(vocab, width), dtype=dtype)
     # batch 500: exercises the production tile configuration (tile_b
     # capped at 256) AND the padded final tile (500 % 256 != 0) — a
@@ -292,7 +296,7 @@ def prevalidate_narrow(widths=(8, 16, 32, 64), dtype=jnp.float32) -> dict:
 def _fused_impl(params, ids, weights, interpret):
     import os
     vocab, width = params.shape
-    if vocab <= ONEHOT_MAX_VOCAB:
+    if vocab <= _onehot_max_vocab():
         return _onehot_lookup(params, ids, weights, interpret=interpret)
     # narrow rows (< 1 lane) make per-row DMAs tiny; whether that still
     # beats XLA's gather is a hardware question — opt in via env until the

@@ -112,6 +112,16 @@ def prevalidate_pallas_scatter() -> bool:
     return ok
 
 
+def _static_float(x):
+    """float(x) when x is compile-time static (Python scalar or concrete
+    array); None when traced — Pallas kernel hyperparameters must be
+    static, so traced values route callers to the XLA path."""
+    try:
+        return float(x)
+    except Exception:  # noqa: BLE001 - ConcretizationTypeError et al.
+        return None
+
+
 def _use_pallas_scatter(ref_array) -> bool:
     """True when DET_SCATTER_IMPL=pallas is active, the backend is TPU, and
     the kernels validated on this chip (eager prevalidate required before
@@ -300,12 +310,15 @@ def sparse_adagrad(table: jax.Array, accum: jax.Array, grad: SparseRowGrad,
                         -lr * g * lax.rsqrt(acc_new + eps), 0.0)
         return table + upd.astype(table.dtype), acc_new
     rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows)
-    if _use_pallas_scatter(table):
+    lr_static = _static_float(lr)
+    if _use_pallas_scatter(table) and lr_static is not None:
         # fused RMW stream: one pass reads+updates table and accumulator
-        # rows together (vs two scatters + a gather of the same rows)
+        # rows together (vs two scatters + a gather of the same rows).
+        # lr must be compile-time static (kernel hyperparameter); a traced
+        # lr (schedule passed through jit args) takes the XLA path
         from distributed_embeddings_tpu.ops import pallas_scatter as ps
-        return ps.adagrad_rows_sorted_unique(table, accum, rep, sums, lr,
-                                             eps)
+        return ps.adagrad_rows_sorted_unique(table, accum, rep, sums,
+                                             lr_static, eps)
     # rep is strictly increasing under the default impl (dedup_sum
     # contract) => both scatter promises hold; without them XLA's
     # duplicate-safe lowering costs ~100-280 ns/row on TPU (round-3 prims

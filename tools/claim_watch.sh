@@ -40,7 +40,7 @@ print('fetch', float(jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128)))))
     echo "$(date +%H:%M:%S) probe $n SUCCESS — tunnel alive" >> "$LOG"
     touch /tmp/tpu_alive_r03c
     bench_rc=1
-    touch "$BUSY"    # bench.py's supervisor waits on this (driver collision)
+    echo $$ > "$BUSY"  # bench.py's supervisor waits while this pid is live
     trap 'rm -f "$BUSY"' EXIT
     for stage in "tools/tpu_mosaic_probe.py:900:mosaic" \
                  "tools/tpu_scatter_probe.py:2700:scatter" \
@@ -63,11 +63,13 @@ print('fetch', float(jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128)))))
     rm -f "$BUSY"
     # success sentinel only when the headline measurement actually landed
     # (a fresh one, not the cached-record fallback)
-    # timestamp whatever landed (even partial stages are evidence)
-    git add tools/watch_*_r03c.out tools/bench_last_tpu.json \
-        tools/claim_watch_r03c.log 2>/dev/null \
-      && git commit -q -m "Hardware window artifacts (claim watcher)" \
-        2>/dev/null || true
+    # timestamp whatever landed (even partial stages are evidence);
+    # pathspec-limited commit: must not sweep unrelated staged work in
+    git add -- tools/watch_*_r03c.out tools/bench_last_tpu.json \
+        tools/claim_watch_r03c.log 2>/dev/null || true
+    git commit -q -m "Hardware window artifacts (claim watcher)" \
+        -- tools/watch_*_r03c.out tools/bench_last_tpu.json \
+        tools/claim_watch_r03c.log 2>/dev/null || true
     if [ "$bench_rc" -eq 0 ] \
        && grep -q '"metric"' tools/watch_bench_r03c.out \
        && ! grep -q '"cached": true' tools/watch_bench_r03c.out; then
