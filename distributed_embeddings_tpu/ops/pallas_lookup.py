@@ -250,7 +250,11 @@ def _narrow_path_ok(width: int, dtype) -> bool:
     XLA fallback for the process (round-3 hardware: the r03 tunnel's
     compile helper crashed on every DMA-kernel compile, so the failure
     path is load-bearing, not theoretical)."""
-    key = (width, jnp.dtype(dtype).name)
+    # the probe table is sized off the per-call DET_ONEHOT_MAX_VOCAB, so the
+    # resolved value is part of the cache key: changing the knob mid-process
+    # must not reuse a verdict measured under a different routing threshold
+    # (ADVICE r3)
+    key = (width, jnp.dtype(dtype).name, _onehot_max_vocab())
     if key in _NARROW_VALIDATED:
         return _NARROW_VALIDATED[key]
     import warnings
@@ -307,7 +311,7 @@ def _fused_impl(params, ids, weights, interpret):
         # under a jit trace the eager hardware check cannot run (it fetches
         # a compiled result); only a cached prevalidate_narrow verdict
         # enables the path there
-        key = (width, jnp.dtype(params.dtype).name)
+        key = (width, jnp.dtype(params.dtype).name, _onehot_max_vocab())
         if isinstance(params, jax.core.Tracer):
             narrow_ok = _NARROW_VALIDATED.get(key, False)
         else:

@@ -69,8 +69,11 @@ def _scatter_kernel(ids_ref, delta_ref, table_ref, out_ref, rows_ref, rsem,
             rows_ref.at[j], out_ref.at[row], wsem.at[j])
 
     def issue(j, fn):
-        # fillers (id >= vocab) issue no DMA: nothing read, nothing written
-        @pl.when(ids_ref[base + j] < vocab)
+        # fillers (id >= vocab) and negative ids issue no DMA: the XLA path
+        # this replaces drops both via mode="drop" (ADVICE r3: a negative id
+        # must not reach table_ref.at[row])
+        row = ids_ref[base + j]
+        @pl.when((row >= 0) & (row < vocab))
         def _():
             fn(j)
 
@@ -100,6 +103,8 @@ def scatter_add_sorted_unique(table: jax.Array, ids: jax.Array,
     """
     vocab, width = table.shape
     n = ids.shape[0]
+    if n == 0:        # empty grad shard: XLA scatter handles this; match it
+        return table
     tile = min(_TILE, n)
     pad = -n % tile
     if pad:
@@ -162,7 +167,8 @@ def _adagrad_kernel(ids_ref, sums_ref, table_ref, acc_ref, out_t, out_a,
                                      aw_sem.at[j])
 
     def guarded(j, fn):
-        @pl.when(ids_ref[base + j] < vocab)
+        row = ids_ref[base + j]
+        @pl.when((row >= 0) & (row < vocab))   # drop fillers AND negatives
         def _():
             fn(j)
 
@@ -203,6 +209,8 @@ def adagrad_rows_sorted_unique(table: jax.Array, accum: jax.Array,
     """
     vocab, width = table.shape
     n = ids.shape[0]
+    if n == 0:        # empty grad shard: nothing to update
+        return table, accum
     tile = min(_TILE, n)
     pad = -n % tile
     if pad:

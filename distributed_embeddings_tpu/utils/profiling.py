@@ -45,13 +45,18 @@ def fetch_sync(out) -> float:
     """
     import jax.numpy as jnp
     total = 0.0
+    fetched = False
     for leaf in jax.tree.leaves(out):
-        if not hasattr(leaf, "dtype"):
-            continue
-        if jnp.issubdtype(leaf.dtype, jnp.bool_) or leaf.size == 0:
+        if not hasattr(leaf, "dtype") or leaf.size == 0:
             continue
         first = leaf.reshape(-1)[0] if leaf.ndim else leaf
         total += float(first.astype(jnp.float32))
+        fetched = True
+    if not fetched:
+        # no fetchable array leaf (empty/none): fall back to
+        # block_until_ready — weaker on axon, but better than silently
+        # timing only dispatch (ADVICE r3)
+        jax.block_until_ready(out)
     return total
 
 

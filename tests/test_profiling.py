@@ -18,14 +18,22 @@ def test_fetch_sync_handles_leaf_zoo():
         "f32": jnp.ones((4, 4)),
         "bf16": jnp.ones((2,), jnp.bfloat16),
         "int": jnp.arange(3),
-        "bool": jnp.ones((2,), bool),          # skipped
+        "bool": jnp.ones((2,), bool),          # fetched as 1.0
         "empty": jnp.zeros((0, 8)),            # skipped
         "scalar": jnp.float32(2.5),
         "none": None,                          # not an array leaf
     }
     total = profiling.fetch_sync(out)
-    # 1.0 (f32[0]) + 1.0 (bf16[0]) + 0 (int[0]) + 2.5 (scalar)
-    assert abs(total - 4.5) < 1e-6
+    # 1.0 (f32[0]) + 1.0 (bf16[0]) + 0 (int[0]) + 1.0 (bool[0]) + 2.5
+    assert abs(total - 5.5) < 1e-6
+
+
+def test_fetch_sync_no_fetchable_leaves_still_syncs():
+    # ADVICE r3: an output of only empty/non-array leaves must not silently
+    # time dispatch-only; fetch_sync falls back to block_until_ready and
+    # returns 0.0 without raising
+    assert profiling.fetch_sync({"e": jnp.zeros((0,)), "n": None}) == 0.0
+    assert profiling.fetch_sync(None) == 0.0
 
 
 def test_benchmark_chained_measures_real_work():
