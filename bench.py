@@ -179,6 +179,66 @@ def run_at_batch(model, batch, iters=10, optimizer="adagrad"):
     return dt
 
 
+def _git_sha() -> str:
+    """HEAD sha at bench time: every record carries the code it measured
+    (round-3 shipped a cached record that predated 15 perf commits —
+    never again without it being visible)."""
+    import subprocess
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=os.path.dirname(
+                os.path.abspath(__file__)), capture_output=True, text=True,
+            timeout=10).stdout.strip()
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def _perf_files_changed_since(sha: str) -> int:
+    """Number of files under ops/ or layers/ changed between `sha` and HEAD
+    — nonzero means a cached record no longer describes this code."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", sha, "HEAD", "--",
+             "distributed_embeddings_tpu/ops",
+             "distributed_embeddings_tpu/layers",
+             "distributed_embeddings_tpu/training.py"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        return len([ln for ln in out.stdout.splitlines() if ln.strip()])
+    except Exception:  # noqa: BLE001
+        return -1
+
+
+def run_ab_arm(extra: dict, key: str, env: dict, cfg, batch: int,
+               iters: int, validate=None):
+    """Run one A/B arm of the synthetic bench under `env` overrides.
+
+    Records `{key}_ms` (and `{key}_valid` when a validator gates the arm,
+    `{key}_error` on failure) into `extra`; returns the arm's step seconds
+    or None when skipped/failed. The model is rebuilt per arm so env-
+    dependent dispatch re-traces."""
+    try:
+        if validate is not None:
+            valid = bool(validate())
+            extra[f"{key}_valid"] = valid
+            if not valid:
+                return None
+        for k, v in env.items():
+            os.environ[k] = v
+        dt = run_at_batch(SyntheticModel(cfg, mesh=None, distributed=True),
+                          batch, iters=iters)
+        extra[f"{key}_ms"] = round(dt * 1e3, 3)
+        extra[f"{key}_raw"] = getattr(run_at_batch, "last_raw", None)
+        return dt
+    except Exception as e:  # noqa: BLE001 - an arm must not kill the bench
+        extra[f"{key}_error"] = str(e)[:200]
+        return None
+    finally:
+        for k in env:
+            os.environ.pop(k, None)
+
+
 # ---------------------------------------------------------------- roofline
 # v5e per-chip peaks (public spec); used only for the efficiency estimate.
 HBM_GBPS = {"v5e": 819.0, "v5p": 2765.0, "v4": 1228.0}
@@ -230,51 +290,37 @@ def run_dlrm_bench(batches=(65536, 32768, 16384), iters=20):
             e.__traceback__ = None
             del e
             continue
-        extra = {"dlrm_timing_raw": getattr(run_at_batch, "last_raw", None)}
-        # dedup-impl A/B (round-3 scatter data): the cumsum impl removes
-        # the segment-sum and rep-build scatters; whether that wins on this
-        # chip is measured here, winner reported
+        extra = {"dlrm_timing_raw": getattr(run_at_batch, "last_raw", None),
+                 "dlrm_ab_sort_ms": round(dt * 1e3, 3)}
+        # aggregation-impl A/B (round-3/4 scatter data): cumsum removes the
+        # segment-sum + rep-build scatters; dense trades a [V, w] temp for
+        # promise-free updates; tiled replaces the whole chain with the
+        # one-hot-matmul kernel. Winner reported.
         if (jax.devices()[0].platform != "cpu"
                 and os.environ.get("DET_BENCH_AB", "1") == "1"):
-            try:
-                os.environ["DET_DEDUP_IMPL"] = "cumsum"
-                dt_cs = run_at_batch(
-                    SyntheticModel(cfg, mesh=None, distributed=True), batch,
-                    iters=iters)
-                extra["dlrm_ab_sort_ms"] = round(dt * 1e3, 3)
-                extra["dlrm_ab_cumsum_ms"] = round(dt_cs * 1e3, 3)
-                if dt_cs < dt:
-                    dt = dt_cs
-                    extra["dlrm_dedup_impl"] = "cumsum"
-                    # the headline is now the cumsum run: its raw timings
-                    # are the provenance record
-                    extra["dlrm_timing_raw"] = getattr(
-                        run_at_batch, "last_raw", None)
-                else:
-                    extra["dlrm_dedup_impl"] = "sort"
-            except Exception as e:  # noqa: BLE001 - A/B must not kill bench
-                extra["dlrm_ab_error"] = str(e)[:200]
-            finally:
-                os.environ.pop("DET_DEDUP_IMPL", None)
-            # strategy A/B: dense aggregation beat sort 2.1x in the round-3
-            # prims (pre-promise-flags); the criteo bucket (333M elems)
-            # auto-picks sort, so measure dense explicitly
-            try:
-                # _pick reads the env per trace, no reload needed
-                os.environ["DET_SPARSE_DENSE_MAX"] = str(500 * 1024 * 1024)
-                dt_dn = run_at_batch(
-                    SyntheticModel(cfg, mesh=None, distributed=True), batch,
-                    iters=iters)
-                extra["dlrm_ab_dense_ms"] = round(dt_dn * 1e3, 3)
-                if dt_dn < dt:
-                    dt = dt_dn
-                    extra["dlrm_strategy"] = "dense"
-                    extra["dlrm_timing_raw"] = getattr(
-                        run_at_batch, "last_raw", None)
-            except Exception as e:  # noqa: BLE001
-                extra["dlrm_ab_dense_error"] = str(e)[:200]
-            finally:
-                os.environ.pop("DET_SPARSE_DENSE_MAX", None)
+            from distributed_embeddings_tpu.ops import sparse_update
+            extra["dlrm_best_path"] = "sort"
+            arms = [
+                ("dlrm_ab_cumsum", {"DET_DEDUP_IMPL": "cumsum"},
+                 None, "cumsum"),
+                # the criteo bucket (333M elems) auto-picks sort; measure
+                # dense explicitly by raising the auto threshold
+                ("dlrm_ab_dense",
+                 {"DET_SPARSE_DENSE_MAX": str(500 * 1024 * 1024)},
+                 None, "dense"),
+                ("dlrm_ab_tiled", {"DET_SCATTER_IMPL": "tiled"},
+                 sparse_update.prevalidate_tiled, "tiled-onehot-matmul"),
+                ("dlrm_ab_tiled_full",
+                 {"DET_SCATTER_IMPL": "tiled", "DET_LOOKUP_PATH": "tiled"},
+                 sparse_update.prevalidate_tiled, "tiled-fwd+bwd"),
+            ]
+            for key, env, validate, label in arms:
+                dt_arm = run_ab_arm(extra, key, env, cfg, batch, iters,
+                                    validate=validate)
+                if dt_arm is not None and dt_arm < dt:
+                    dt = dt_arm
+                    extra["dlrm_best_path"] = label
+                    extra["dlrm_timing_raw"] = extra.get(f"{key}_raw")
         dev = jax.devices()[0]
         gen = _chip_gen(dev)
         widths, hot = [], []
@@ -425,6 +471,24 @@ def _emit_cached_record(reason: str) -> bool:
         return False
     record["cached"] = True
     record["cached_reason"] = reason[:200]
+    # staleness: a cached record measured at sha X no longer describes HEAD
+    # when perf-relevant files changed since (VERDICT r3 item 4)
+    measured_sha = record.get("git_sha", "")
+    if measured_sha and measured_sha != "unknown":
+        changed = _perf_files_changed_since(measured_sha)
+        if changed < 0:
+            record["cached_stale"] = True
+            record["cached_stale_reason"] = (
+                f"could not diff measured sha {measured_sha[:12]} against "
+                "HEAD (git unavailable or sha unknown)")
+        elif changed:
+            record["cached_stale"] = True
+            record["cached_stale_reason"] = (
+                f"{changed} perf-relevant files (ops/layers/training) "
+                f"changed between measured sha {measured_sha[:12]} and HEAD")
+    else:
+        record["cached_stale"] = True
+        record["cached_stale_reason"] = "cached record predates git_sha field"
     print(json.dumps(record))
     return True
 
@@ -475,6 +539,7 @@ def main():
             "unit": "ms",
             "vs_baseline": round(throughput / baseline_throughput, 3),
             "tiny_timing_raw": getattr(run_at_batch, "last_raw", None),
+            "git_sha": _git_sha(),
         }
         try:
             from distributed_embeddings_tpu.models.synthetic import (
@@ -549,55 +614,40 @@ def main():
             finally:
                 os.environ.pop("DET_LOOKUP_PATH", None)
                 os.environ.pop("DET_PALLAS_NARROW", None)
-            # third arm: scatter-free cumsum dedup (round-3 scatter data)
-            try:
-                os.environ["DET_DEDUP_IMPL"] = "cumsum"
-                dt_cs = run_at_batch(
-                    SyntheticModel(cfg, mesh=None, distributed=True), batch)
-                record["tiny_ab_cumsum_ms"] = round(dt_cs * 1e3, 3)
-                if dt_cs * 1e3 < record["value"]:
-                    record["value"] = round(dt_cs * 1e3, 3)
+            # remaining arms (round-3/4 scatter-bottleneck responses), each
+            # through the shared runner; winner takes the headline.
+            from distributed_embeddings_tpu.ops import sparse_update
+            arms = [
+                # scatter-free cumsum dedup
+                ("tiny_ab_cumsum", {"DET_DEDUP_IMPL": "cumsum"},
+                 None, "xla+cumsum-dedup"),
+                # per-row DMA RMW scatter (round 3; gated on hardware
+                # validation — r03 toolchain rejected all DMA kernels)
+                ("tiny_ab_pallas_scatter", {"DET_SCATTER_IMPL": "pallas"},
+                 sparse_update.prevalidate_pallas_scatter,
+                 "pallas-rmw-scatter"),
+                # round-4 tiled one-hot-matmul kernels: BlockSpec streams
+                # only, aggregation on the MXU (ops/pallas_tiled.py)
+                ("tiny_ab_tiled", {"DET_SCATTER_IMPL": "tiled"},
+                 sparse_update.prevalidate_tiled, "tiled-onehot-matmul"),
+                # forward gather through the tiled kernel as well
+                ("tiny_ab_tiled_full",
+                 {"DET_SCATTER_IMPL": "tiled", "DET_LOOKUP_PATH": "tiled"},
+                 sparse_update.prevalidate_tiled, "tiled-fwd+bwd"),
+            ]
+            for key, env, validate, label in arms:
+                dt_arm = run_ab_arm(record, key, env, cfg, batch, 10,
+                                    validate=validate)
+                if dt_arm is not None and dt_arm * 1e3 < record["value"]:
+                    record["value"] = round(dt_arm * 1e3, 3)
                     record["vs_baseline"] = round(
-                        (batch / dt_cs) / baseline_throughput, 3)
-                    record["tiny_best_path"] = "xla+cumsum-dedup"
-                    record["tiny_timing_raw"] = getattr(
-                        run_at_batch, "last_raw", None)
+                        (batch / dt_arm) / baseline_throughput, 3)
+                    record["tiny_best_path"] = label
+                    record["tiny_timing_raw"] = record.get(f"{key}_raw")
                     if "tiny_roofline_step_ms" in record:
                         record["tiny_roofline_frac"] = round(
                             record["tiny_roofline_step_ms"]
                             / record["value"], 3)
-            except Exception as e:  # noqa: BLE001
-                record["tiny_ab_cumsum_error"] = str(e)[:200]
-            finally:
-                os.environ.pop("DET_DEDUP_IMPL", None)
-            # fourth arm: Pallas RMW scatter for the row updates (gated on
-            # an eager hardware validation — compile failures just record)
-            try:
-                from distributed_embeddings_tpu.ops import sparse_update
-                record["tiny_ab_pallas_scatter_valid"] = (
-                    sparse_update.prevalidate_pallas_scatter())
-                if record["tiny_ab_pallas_scatter_valid"]:
-                    os.environ["DET_SCATTER_IMPL"] = "pallas"
-                    dt_ps = run_at_batch(
-                        SyntheticModel(cfg, mesh=None, distributed=True),
-                        batch)
-                    record["tiny_ab_pallas_scatter_ms"] = round(
-                        dt_ps * 1e3, 3)
-                    if dt_ps * 1e3 < record["value"]:
-                        record["value"] = round(dt_ps * 1e3, 3)
-                        record["vs_baseline"] = round(
-                            (batch / dt_ps) / baseline_throughput, 3)
-                        record["tiny_best_path"] = "pallas-rmw-scatter"
-                        record["tiny_timing_raw"] = getattr(
-                            run_at_batch, "last_raw", None)
-                        if "tiny_roofline_step_ms" in record:
-                            record["tiny_roofline_frac"] = round(
-                                record["tiny_roofline_step_ms"]
-                                / record["value"], 3)
-            except Exception as e:  # noqa: BLE001
-                record["tiny_ab_pallas_scatter_error"] = str(e)[:200]
-            finally:
-                os.environ.pop("DET_SCATTER_IMPL", None)
         # secondary workload: DLRM samples/sec + HBM roofline (north-star
         # metric, BASELINE.json) — carried in the same single JSON line
         try:

@@ -631,8 +631,23 @@ class DistributedEmbedding:
         import os
         b_sz, f, k = ids.shape
         path = os.environ.get("DET_LOOKUP_PATH", "auto")
-        if combiner is None and k == 1 and path == "pallas":
+        if combiner is None and k == 1 and path in ("pallas", "tiled"):
             combiner = "sum"     # identical result at hotness 1
+        if path == "tiled" and combiner in ("sum", "mean"):
+            # round-4 tiled one-hot-matmul gather (ops/pallas_tiled.py):
+            # sort + block-streamed table walk, replacing the ~22 ns/row
+            # descriptor-bound XLA row gather. Compiled use requires the
+            # eager hardware validation (prevalidate_active_impl); off-TPU
+            # it runs in interpret mode (tests)
+            from distributed_embeddings_tpu.ops import (pallas_tiled,
+                                                        sparse_update)
+            if sparse_update.tiled_kernels_ok(table):
+                w = (weights if weights is not None
+                     else jnp.ones((b_sz, f, k), jnp.float32))
+                out = pallas_tiled.tiled_embedding_lookup(
+                    table, ids.reshape(b_sz * f, k), w.reshape(b_sz * f, k),
+                    combiner)
+                return self._cast(out.reshape(b_sz, f, out.shape[-1]))
         want_pallas = (self.use_custom_kernel
                        and pallas_lookup.is_tpu_backend()
                        and combiner in ("sum", "mean")

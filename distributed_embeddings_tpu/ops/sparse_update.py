@@ -66,50 +66,165 @@ def dedup_flags() -> dict:
             "indices_are_sorted": _dedup_impl() == "sort"}
 
 
-# --------------------------- Pallas RMW scatter dispatch (opt-in, validated)
-_PALLAS_SCATTER_OK = None     # None = unvalidated this process
+# ------------- hardware-gated kernel dispatch (shared gate machinery)
+# Each alternative kernel implementation rides the same pattern: an EAGER
+# compiled correctness check against the XLA formulation on the attached
+# backend, a per-process verdict cache, and a dispatch predicate that
+# consults only the cache under a jit trace (the check itself fetches
+# compiled results, which is illegal while tracing). Compile failures count
+# as not-validated: the r03 tunnel toolchain rejected every DMA kernel, so
+# the failure path is load-bearing.
+class _KernelGate:
+    def __init__(self, env_value: str, validator, what: str):
+        self.env_value = env_value      # DET_SCATTER_IMPL value that opts in
+        self.validator = validator      # () -> bool, may raise
+        self.what = what
+        self.verdict = None             # None = unvalidated this process
+
+    def prevalidate(self) -> bool:
+        if self.verdict is not None:
+            return self.verdict
+        import warnings
+        try:
+            ok = bool(self.validator())
+        except Exception as e:  # noqa: BLE001 - toolchain may reject kernels
+            warnings.warn(f"{self.what}: kernel failed to compile/run on "
+                          f"this backend ({str(e)[:200]}); using XLA paths")
+            ok = False
+        self.verdict = ok
+        return ok
+
+    def active(self, ref_array) -> bool:
+        if (os.environ.get("DET_SCATTER_IMPL", "xla") != self.env_value
+                or jax.default_backend() != "tpu"):
+            return False
+        if isinstance(ref_array, jax.core.Tracer):
+            return bool(self.verdict)
+        return self.prevalidate()
+
+
+def _validate_tiled() -> bool:
+    """Compiled correctness of the tiled one-hot-matmul kernels
+    (ops/pallas_tiled.py): gather, sgd and fused adagrad vs XLA."""
+    import numpy as np
+    from distributed_embeddings_tpu.ops import pallas_tiled as ptl
+    rng = np.random.RandomState(0)
+    v, w, n = 4096, 16, 2048
+    ids = jnp.asarray(rng.randint(0, v, n).astype(np.int32))
+    delta = jnp.asarray(rng.randn(n, w).astype(np.float32))
+    table = jnp.asarray(rng.randn(v, w).astype(np.float32))
+    got = ptl.tiled_sgd(table, ids, delta, 0.05, interpret=False)
+    want = table.at[ids].add(-0.05 * delta, mode="drop")
+    ok = bool(jnp.max(jnp.abs(got - want)) < 1e-3)
+    acc = jnp.full((v, w), 0.1, jnp.float32)
+    t2, a2 = ptl.tiled_adagrad(table, acc, ids, delta, 0.05,
+                               interpret=False)
+    rep, sums = dedup_sum(ids, delta, sentinel=v)
+    a_want = acc.at[rep].add(sums * sums, mode="drop", **dedup_flags())
+    d_want = -0.05 * sums * lax.rsqrt(
+        jnp.take(a_want, jnp.minimum(rep, v - 1), axis=0) + 1e-10)
+    t_want = table.at[rep].add(d_want, mode="drop", **dedup_flags())
+    ok = (ok and bool(jnp.max(jnp.abs(a2 - a_want)) < 1e-3)
+          and bool(jnp.max(jnp.abs(t2 - t_want)) < 1e-3))
+    g3 = ptl.tiled_gather(table, ids, interpret=False)
+    ok = ok and bool(
+        jnp.max(jnp.abs(g3 - jnp.take(table, ids, axis=0))) < 1e-4)
+    mu = jnp.zeros((v, w), jnp.float32)
+    nu = jnp.zeros((v, w), jnp.float32)
+    cnt = jnp.zeros((), jnp.int32)
+    t4, mu4, nu4, c4 = ptl.tiled_adam(table, mu, nu, cnt, ids, delta, 0.01,
+                                      interpret=False)
+    tw, muw, nuw, cw = sparse_adam(table, mu, nu, cnt,
+                                   SparseRowGrad(ids, delta), 0.01,
+                                   strategy="sort")
+    return (ok and bool(jnp.max(jnp.abs(t4 - tw)) < 1e-3)
+            and bool(jnp.max(jnp.abs(mu4 - muw)) < 1e-3)
+            and bool(jnp.max(jnp.abs(nu4 - nuw)) < 1e-3))
+
+
+def _validate_pallas_scatter() -> bool:
+    """Compiled correctness of the per-row DMA RMW kernels
+    (ops/pallas_scatter.py): scatter-add + fused adagrad vs XLA."""
+    import numpy as np
+    from distributed_embeddings_tpu.ops import pallas_scatter as ps
+    rng = np.random.RandomState(0)
+    v, w, n = 4096, 16, 512
+    ids = jnp.asarray(np.sort(rng.choice(v, n, replace=False))
+                      .astype(np.int32))
+    delta = jnp.asarray(rng.randn(n, w).astype(np.float32))
+    table = jnp.zeros((v, w), jnp.float32)
+    got = ps.scatter_add_sorted_unique(table, ids, delta, interpret=False)
+    want = table.at[ids].add(delta, mode="drop")
+    ok = bool(jnp.max(jnp.abs(got - want)) < 1e-5)
+    # the fused adagrad kernel rides the same gate
+    acc = jnp.full((v, w), 0.1, jnp.float32)
+    t2, a2 = ps.adagrad_rows_sorted_unique(table, acc, ids, delta, 0.05,
+                                           interpret=False)
+    a_want = acc.at[ids].add(delta * delta, mode="drop")
+    d_want = -0.05 * delta * lax.rsqrt(jnp.take(a_want, ids, axis=0) + 1e-10)
+    t_want = table.at[ids].add(d_want, mode="drop")
+    return (ok and bool(jnp.max(jnp.abs(a2 - a_want)) < 1e-5)
+            and bool(jnp.max(jnp.abs(t2 - t_want)) < 1e-5))
+
+
+_TILED_GATE = _KernelGate("tiled", _validate_tiled,
+                          "DET_SCATTER_IMPL=tiled")
+_PALLAS_GATE = _KernelGate("pallas", _validate_pallas_scatter,
+                           "DET_SCATTER_IMPL=pallas")
+
+
+def prevalidate_tiled() -> bool:
+    return _TILED_GATE.prevalidate()
+
+
+def tiled_kernels_ok(ref_array) -> bool:
+    """Hardware-validation verdict for the tiled kernels, independent of
+    which knob routed here (env knob or explicit strategy="tiled"). Off-TPU
+    the kernels run in interpret mode — always ok. Under a jit trace only
+    the cached verdict is consulted (prevalidate_active_impl runs the eager
+    check); an unvalidated compiled path is NEVER dispatched."""
+    if jax.default_backend() != "tpu":
+        return True
+    if isinstance(ref_array, jax.core.Tracer):
+        return bool(_TILED_GATE.verdict)
+    return _TILED_GATE.prevalidate()
+
+
+def _use_tiled(ref_array) -> bool:
+    return (os.environ.get("DET_SCATTER_IMPL", "xla") == "tiled"
+            and jax.default_backend() == "tpu"
+            and tiled_kernels_ok(ref_array))
+
+
+def _tiled_route(strategy: str, ref_array) -> bool:
+    """True when the tiled kernels should serve this update: explicit
+    strategy='tiled' (validation-gated on TPU, interpret off-TPU) or
+    auto + DET_SCATTER_IMPL=tiled. An explicitly-requested but
+    unvalidated tiled path falls back to the XLA sort path — the gate
+    exists precisely because this toolchain rejects whole kernel classes."""
+    if strategy == "tiled":
+        return tiled_kernels_ok(ref_array)
+    return strategy == "auto" and _use_tiled(ref_array)
 
 
 def prevalidate_pallas_scatter() -> bool:
-    """Eager compiled correctness check of the Pallas sorted-unique RMW
-    scatter kernel (ops/pallas_scatter.py) on this backend. Must run
-    OUTSIDE any jit trace; traced code consults the cached verdict and
-    falls back to XLA when unvalidated. Compile failures (the round-3
-    tunnel toolchain rejects every DMA kernel) count as not-validated."""
-    global _PALLAS_SCATTER_OK
-    if _PALLAS_SCATTER_OK is not None:
-        return _PALLAS_SCATTER_OK
-    import numpy as np
-    import warnings
-    try:
-        from distributed_embeddings_tpu.ops import pallas_scatter as ps
-        rng = np.random.RandomState(0)
-        v, w, n = 4096, 16, 512
-        ids = jnp.asarray(np.sort(rng.choice(v, n, replace=False))
-                          .astype(np.int32))
-        delta = jnp.asarray(rng.randn(n, w).astype(np.float32))
-        table = jnp.zeros((v, w), jnp.float32)
-        got = ps.scatter_add_sorted_unique(table, ids, delta,
-                                           interpret=False)
-        want = table.at[ids].add(delta, mode="drop")
-        ok = bool(jnp.max(jnp.abs(got - want)) < 1e-5)
-        # the fused adagrad kernel rides the same gate
-        acc = jnp.full((v, w), 0.1, jnp.float32)
-        t2, a2 = ps.adagrad_rows_sorted_unique(table, acc, ids, delta, 0.05,
-                                               interpret=False)
-        a_want = acc.at[ids].add(delta * delta, mode="drop")
-        d_want = -0.05 * delta * lax.rsqrt(
-            jnp.take(a_want, ids, axis=0) + 1e-10)
-        t_want = table.at[ids].add(d_want, mode="drop")
-        ok = (ok and bool(jnp.max(jnp.abs(a2 - a_want)) < 1e-5)
-              and bool(jnp.max(jnp.abs(t2 - t_want)) < 1e-5))
-    except Exception as e:  # noqa: BLE001 - toolchain may reject the kernel
-        warnings.warn(f"DET_SCATTER_IMPL=pallas: kernel failed to "
-                      f"compile/run on this backend ({str(e)[:200]}); "
-                      "using XLA scatter")
-        ok = False
-    _PALLAS_SCATTER_OK = ok
-    return ok
+    return _PALLAS_GATE.prevalidate()
+
+
+def prevalidate_active_impl(strategy: Optional[str] = None) -> None:
+    """Eagerly validate whichever kernel impl the env knobs (or an explicit
+    strategy= argument) select so subsequently-traced train steps can
+    dispatch to it. Call once before jitting a train step; no-op for the
+    XLA default. Wired into make_sparse_train_step, so user code need not
+    call it."""
+    impl = os.environ.get("DET_SCATTER_IMPL", "xla")
+    if jax.default_backend() != "tpu":
+        return
+    if (impl == "tiled" or strategy == "tiled"
+            or os.environ.get("DET_LOOKUP_PATH") == "tiled"):
+        _TILED_GATE.prevalidate()
+    if impl == "pallas":
+        _PALLAS_GATE.prevalidate()
 
 
 def _static_float(x):
@@ -123,15 +238,7 @@ def _static_float(x):
 
 
 def _use_pallas_scatter(ref_array) -> bool:
-    """True when DET_SCATTER_IMPL=pallas is active, the backend is TPU, and
-    the kernels validated on this chip (eager prevalidate required before
-    traced use)."""
-    if (os.environ.get("DET_SCATTER_IMPL", "xla") != "pallas"
-            or jax.default_backend() != "tpu"):
-        return False
-    if isinstance(ref_array, jax.core.Tracer):
-        return bool(_PALLAS_SCATTER_OK)
-    return prevalidate_pallas_scatter()
+    return _PALLAS_GATE.active(ref_array)
 
 
 def _row_scatter_add(table: jax.Array, rep: jax.Array,
@@ -211,7 +318,13 @@ def dedup_sum(ids: jax.Array, contribs: jax.Array, sentinel: int):
     """
     n = ids.shape[0]
     iota = lax.iota(jnp.int32, n)
-    keys = jnp.minimum(ids.astype(jnp.int32), jnp.int32(sentinel))
+    # collapse BOTH invalid sides onto the sentinel: a plain min() would let
+    # negative ids through, and JAX scatters treat negative indices as
+    # NumPy-style from-the-end (mode="drop" only drops ids outside [-V, V)),
+    # silently updating the TAIL of the table (ADVICE r3 medium)
+    ids32 = ids.astype(jnp.int32)
+    keys = jnp.where(ids32 < 0, jnp.int32(sentinel),
+                     jnp.minimum(ids32, jnp.int32(sentinel)))
     sid, perm = lax.sort_key_val(keys, iota)
     rows = jnp.take(contribs, perm, axis=0)
     is_start = jnp.concatenate(
@@ -259,7 +372,10 @@ def _dense_sum(ids, contribs, rows):
     ext = jnp.concatenate(
         [contribs.astype(jnp.float32),
          jnp.ones((contribs.shape[0], 1), jnp.float32)], axis=1)
-    dense_ext = jnp.zeros((rows, w + 1), jnp.float32).at[ids].add(
+    # negative ids would wrap NumPy-style onto the table tail (see
+    # dedup_sum); route them to the dropped OOB row instead
+    safe_ids = jnp.where(ids < 0, rows, ids)
+    dense_ext = jnp.zeros((rows, w + 1), jnp.float32).at[safe_ids].add(
         ext, mode="drop")
     return dense_ext[:, :w], dense_ext[:, w] > 0
 
@@ -274,7 +390,8 @@ def _pick(strategy: str, rows: int, width: int) -> str:
 
 
 # ------------------------------------------------------------------ SGD
-def sparse_sgd(table: jax.Array, grad: SparseRowGrad, lr) -> jax.Array:
+def sparse_sgd(table: jax.Array, grad: SparseRowGrad, lr,
+               strategy: str = "auto") -> jax.Array:
     """table[ids] -= lr * contribs. Duplicates need no aggregation (add is
     associative); OOB/padded ids are dropped by the scatter.
 
@@ -283,11 +400,16 @@ def sparse_sgd(table: jax.Array, grad: SparseRowGrad, lr) -> jax.Array:
     while the deduped scatter is unique(+sorted) and Pallas-eligible —
     whether sort+aggregate+promised-scatter beats one raw scatter is a
     hardware question, hence opt-in."""
+    if _tiled_route(strategy, table):
+        from distributed_embeddings_tpu.ops import pallas_tiled as ptl
+        return ptl.tiled_sgd(table, grad.ids, grad.contribs, lr)
     if os.environ.get("DET_SGD_DEDUP", "0") == "1":
         rep, sums = dedup_sum(grad.ids, grad.contribs,
                               sentinel=table.shape[0])
         return _row_scatter_add(table, rep, -lr * sums)
-    return table.at[grad.ids].add(
+    # negative ids -> dropped OOB row, not NumPy wraparound (see dedup_sum)
+    safe_ids = jnp.where(grad.ids < 0, table.shape[0], grad.ids)
+    return table.at[safe_ids].add(
         (-lr * grad.contribs.astype(jnp.float32)).astype(table.dtype),
         mode="drop")
 
@@ -302,6 +424,13 @@ def sparse_adagrad(table: jax.Array, accum: jax.Array, grad: SparseRowGrad,
     Returns (new_table, new_accum).
     """
     rows = table.shape[0]
+    if _tiled_route(strategy, table):
+        # tiled one-hot-matmul kernel: sort + in-kernel aggregation, no
+        # dedup pass, no scatter (see ops/pallas_tiled.py). Explicit
+        # strategy="tiled" runs in interpret mode off-TPU (tests).
+        from distributed_embeddings_tpu.ops import pallas_tiled as ptl
+        return ptl.tiled_adagrad(table, accum, grad.ids, grad.contribs,
+                                 lr, eps=eps)
     how = _pick(strategy, rows, table.shape[-1])
     if how == "dense":
         g, touched = _dense_sum(grad.ids, grad.contribs, rows)
@@ -345,6 +474,10 @@ def sparse_adam(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
     (table, mu, nu, count).
     """
     rows = table.shape[0]
+    if _tiled_route(strategy, table):
+        from distributed_embeddings_tpu.ops import pallas_tiled as ptl
+        return ptl.tiled_adam(table, mu, nu, count, grad.ids, grad.contribs,
+                              lr, b1=b1, b2=b2, eps=eps)
     count = count + 1
     c1 = 1.0 - b1 ** count.astype(jnp.float32)
     c2 = 1.0 - b2 ** count.astype(jnp.float32)
@@ -455,7 +588,8 @@ def make_sparse_optimizer(kind: str, lr, strategy: str = "auto",
     if kind == "sgd":
         return SparseOptimizer(
             "sgd", lambda table: (),
-            lambda table, state, g: (sparse_sgd(table, g, lr), ()),
+            lambda table, state, g: (sparse_sgd(table, g, lr,
+                                                strategy=strategy), ()),
             lr, hp_t)
     if kind == "adagrad":
         init_acc = hp.get("initial_accumulator_value", 0.1)

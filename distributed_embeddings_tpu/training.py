@@ -190,7 +190,8 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
         kept in the opt state).
       dense_optimizer: optional optax optimizer for the dense part
         (default: the optax twin of `optimizer`).
-      strategy: sparse dedup strategy ('auto' | 'sort' | 'dense').
+      strategy: sparse aggregation strategy ('auto' | 'sort' | 'dense' |
+        'tiled' — the Pallas one-hot-matmul kernels).
 
     Returns (init_fn, step_fn):
       init_fn(params) -> opt_state
@@ -204,6 +205,12 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
     # same rule (reference: one Keras optimizer instance for the whole model)
     sparse_hp = {"adagrad": {"eps": 1e-7}, "adam": {}, "sgd": {}}[optimizer]
     scheduled = callable(lr)
+    # eagerly validate any DET_SCATTER_IMPL kernel choice on the attached
+    # chip now — inside the traced step only the cached verdict is
+    # consulted, so without this call the env knob would be silently inert
+    from distributed_embeddings_tpu.ops.sparse_update import (
+        prevalidate_active_impl)
+    prevalidate_active_impl(strategy=strategy)
     sopt = make_sparse_optimizer(optimizer, 0.0 if scheduled else lr,
                                  strategy=strategy, **sparse_hp)
     if dense_optimizer is None:

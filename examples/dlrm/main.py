@@ -59,7 +59,11 @@ def parse_args(argv=None):
     p.add_argument("--amp", action="store_true",
                    help="bfloat16 compute (reference AMP analogue)")
     p.add_argument("--dist_strategy", default="memory_balanced",
-                   choices=["basic", "memory_balanced", "memory_optimized"])
+                   choices=["basic", "memory_balanced", "memory_optimized",
+                            "comm_balanced", "auto"],
+                   help="table placement: the three reference strategies "
+                        "plus comm_balanced (exchange-padding-aware) and "
+                        "auto (the library default)")
     p.add_argument("--column_slice_threshold", type=int, default=None)
     p.add_argument("--row_slice_threshold", type=int, default=None)
     p.add_argument("--data_parallel_threshold", type=int, default=None)

@@ -25,8 +25,10 @@ while true; do
     exit 0
   fi
   n=$((n+1))
-  # must see a real accelerator (JAX can silently fall back to CPU)
-  if timeout 90 python -c "
+  # must see a real accelerator (JAX can silently fall back to CPU).
+  # -k: a wedged axon client can ignore SIGTERM indefinitely (observed
+  # 2026-07-31: one probe blocked the loop for 2h) — follow up with KILL
+  if timeout -k 15 90 python -c "
 import jax
 d = jax.devices()
 print(d)
@@ -48,7 +50,7 @@ print('fetch', float(jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128)))))
       fi
       echo "$(date +%H:%M:%S) running $name" >> "$LOG"
       # shellcheck disable=SC2086
-      DET_BENCH_SKIP_BUSY_WAIT=1 timeout "$secs" python -u $cmd \
+      DET_BENCH_SKIP_BUSY_WAIT=1 timeout -k 30 "$secs" python -u $cmd \
         > "tools/watch_${name}_r04.out" 2>&1
       rc=$?
       echo "$(date +%H:%M:%S) $name rc=$rc" >> "$LOG"

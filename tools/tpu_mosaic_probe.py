@@ -176,9 +176,76 @@ def rung_rmw_scatter():
     assert err < 1e-5, f"rmw mismatch {err}"
 
 
+def rung_blockspec_gather():
+    """The round-4 tiled kernels' ONLY nonstandard feature combo, minimal:
+    scalar-prefetched arrays driving BlockSpec index maps on inputs AND a
+    revisited output block, SMEM scalar input, input_output_aliasing — no
+    make_async_copy anywhere. If this compiles, ops/pallas_tiled.py
+    compiles."""
+    tile, chunk, w = 8, 128, W
+
+    def kern(tof_ref, cof_ref, ids_ref, hp_ref, t_ref, o_ref, acc):
+        g = pl.program_id(0)
+        t = tof_ref[g]
+        local = (ids_ref[0, :] - t * tile)[None, :]
+        r = jax.lax.broadcasted_iota(jnp.int32, (tile, chunk), 0)
+        oh = (r == local).astype(jnp.float32)
+        part = jnp.sum(oh, axis=1, keepdims=True) * hp_ref[0, 0]
+
+        @pl.when(g == 0)
+        def _():
+            acc[:] = jnp.zeros_like(acc)
+        acc[:] = acc[:] + part
+
+        @pl.when(g == pl.num_programs(0) - 1)
+        def _():
+            o_ref[:] = t_ref[:] + acc[:]
+
+    v = 4 * tile
+    tof = jnp.zeros((2,), jnp.int32)          # both steps hit tile 0
+    cof = jnp.arange(2, dtype=jnp.int32)
+    ids = jnp.arange(2 * chunk, dtype=jnp.int32).reshape(2, chunk) % tile
+    hp = jnp.full((1, 1), 2.0, jnp.float32)
+    table = jnp.zeros((v, w), jnp.float32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(2,),
+        in_specs=[
+            pl.BlockSpec((1, chunk), lambda g, tof, cof: (cof[g], 0)),
+            pl.BlockSpec((1, 1), lambda g, tof, cof: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((tile, w), lambda g, tof, cof: (tof[g], 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, w), lambda g, tof, cof: (tof[g], 0)),
+        scratch_shapes=[pltpu.VMEM((tile, 1), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((v, w), jnp.float32),
+        input_output_aliases={4: 0},
+    )(tof, cof, ids, hp, table)
+    # each tile-0 row id appears 2*chunk/tile times per... each chunk has
+    # chunk/tile occurrences of each local row; 2 chunks * 2.0 scaling
+    want = 2 * (chunk // tile) * 2.0
+    assert float(out[0, 0]) == want, f"{float(out[0, 0])} != {want}"
+
+
+def rung_tiled_kernels():
+    """The full round-4 production candidates (ops/pallas_tiled.py) at a
+    small shape: tiled adagrad + tiled gather vs XLA."""
+    import sys as _sys
+    import os as _os
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    from distributed_embeddings_tpu.ops import sparse_update as su
+    assert su._validate_tiled(), "tiled kernels compiled but mismatch XLA"
+
+
 RUNGS = [("vmem", rung_vmem), ("anyspace", rung_anyspace), ("dma", rung_dma),
          ("dyn_dma", rung_dyn_dma), ("prefetch", rung_prefetch),
-         ("loop_dma", rung_loop_dma), ("rmw_scatter", rung_rmw_scatter)]
+         ("loop_dma", rung_loop_dma), ("rmw_scatter", rung_rmw_scatter),
+         ("blockspec_gather", rung_blockspec_gather),
+         ("tiled_kernels", rung_tiled_kernels)]
 
 
 def main():

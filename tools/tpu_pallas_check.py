@@ -77,6 +77,11 @@ def bench(fn, table, ids, weights, iters=20):
     out = lf(out[0])
     fetch(out)
     t2 = time.perf_counter() - t0
+    # raw provenance rides along (VERDICT r3 item 10): t2 ~ 2x t1 confirms
+    # the slope is clean; t1 ~ t2 means overhead-dominated — treat the
+    # per-iter number with suspicion
+    bench.last_raw = {"t1_ms": round(t1 * 1e3, 3),
+                      "t2_ms": round(t2 * 1e3, 3), "iters": iters}
     return max(t2 - t1, 1e-9) / iters * 1e3
 
 
@@ -139,14 +144,17 @@ def main():
         scale = float(jnp.max(jnp.abs(ref))) + 1e-6
         ok = err / scale < 1e-5
         t_pallas = bench(fused, table, ids, weights, iters=20)
+        raw_p = bench.last_raw
         t_xla = bench(jax.jit(lambda t, i, w: xla_ref(t, i, w, comb)),
                       table, ids, weights, iters=20)
+        raw_x = bench.last_raw
         status = "ok  " if ok else "BAD "
         if not ok:
             failures += 1
         print(f"{status}{tag}: relerr={err / scale:.2e} "
               f"pallas={t_pallas:.3f}ms xla={t_xla:.3f}ms "
-              f"speedup={t_xla / t_pallas:.2f}x compile={compile_s:.1f}s",
+              f"speedup={t_xla / t_pallas:.2f}x compile={compile_s:.1f}s "
+              f"raw_pallas={raw_p} raw_xla={raw_x}",
               flush=True)
 
     # grad path (XLA scatter-add through custom_vjp) on one mid case

@@ -240,6 +240,79 @@ def main():
         RESULTS["pallas_rmw_scatter"] = f"FAIL {str(e)[:200]}"
         print(f"pallas_rmw_scatter: FAIL {str(e)[:300]}", flush=True)
 
+    # round-4 tiled one-hot-matmul kernels (ops/pallas_tiled.py): BlockSpec
+    # streams only — the form this toolchain compiles (the one-hot lookup
+    # kernel compiles; the DMA kernels do not). Timed at the two real
+    # workload shapes with duplicate ids straight in (no dedup pass), plus
+    # a (tile, chunk) sweep on the tiny-class shape.
+    try:
+        from distributed_embeddings_tpu.ops import pallas_tiled as ptl
+        # compiled correctness at a small shape first
+        small_ids = jnp.asarray(rng.integers(0, 10_000, 4096)
+                                .astype(np.int32))
+        small_d = jnp.asarray(
+            rng.standard_normal((4096, 16), dtype=np.float32))
+        small_t = jnp.asarray(
+            rng.standard_normal((10_000, 16), dtype=np.float32))
+        small_a = jnp.full((10_000, 16), 0.1, jnp.float32)
+        got_t, got_a = ptl.tiled_adagrad(small_t, small_a, small_ids,
+                                         small_d, 0.01, interpret=False)
+        want_t, want_a = su.sparse_adagrad(
+            small_t, small_a, su.SparseRowGrad(small_ids, small_d), 0.01,
+            strategy="sort")
+        err = float(jnp.max(jnp.abs(got_t - want_t)))
+        assert err < 1e-3, f"tiled_adagrad mismatch {err}"
+        RESULTS["tiled_correctness"] = "PASS"
+        print("tiled correctness: PASS", flush=True)
+
+        for (v2, n2, w2) in ((25_000_000, 720_896, 16),
+                             (2_600_000, 1_703_936, 128)):
+            tbl2 = jnp.zeros((v2, w2), jnp.float32)
+            acc2 = jnp.full((v2, w2), 0.1, jnp.float32)
+            ids2 = jnp.asarray(rng.integers(0, v2, n2).astype(np.int32))
+            d2 = jnp.asarray(
+                rng.standard_normal((n2, w2), dtype=np.float32))
+
+            def step_tiled(s, v2=v2, d2=d2):
+                t, a, i = s
+                t, a = ptl.tiled_adagrad(t, a, i, d2, 0.01,
+                                         interpret=False)
+                return t, a, (i * 1103515245 + 12345) % v2
+
+            timed_chain(step_tiled, (tbl2, acc2, ids2), iters=6,
+                        label=f"tiled_adagrad dupes n={n2} V={v2//1000}k "
+                              f"w={w2}")
+
+            def step_tgather(s, d2=d2):
+                t, i = s
+                out = ptl.tiled_gather(t, i, interpret=False)
+                return t, (i + out[0, 0].astype(jnp.int32) % 2)
+
+            timed_chain(step_tgather, (tbl2, ids2), iters=6,
+                        label=f"tiled_gather dupes n={n2} V={v2//1000}k "
+                              f"w={w2}")
+            del tbl2, acc2, ids2, d2
+
+        # block-size sweep at the tiny-class shape
+        v3, n3, w3 = 25_000_000, 720_896, 16
+        tbl3 = jnp.zeros((v3, w3), jnp.float32)
+        acc3 = jnp.full((v3, w3), 0.1, jnp.float32)
+        ids3 = jnp.asarray(rng.integers(0, v3, n3).astype(np.int32))
+        d3 = jnp.asarray(rng.standard_normal((n3, w3), dtype=np.float32))
+        for tile in (1024, 2048, 4096):
+            for chunk in (512, 1024):
+                def step_sweep(s, tile=tile, chunk=chunk):
+                    t, a, i = s
+                    t, a = ptl.tiled_adagrad(t, a, i, d3, 0.01, tile=tile,
+                                             chunk=chunk, interpret=False)
+                    return t, a, (i * 1103515245 + 12345) % v3
+                timed_chain(step_sweep, (tbl3, acc3, ids3), iters=6,
+                            label=f"tiled_adagrad T={tile} C={chunk} "
+                                  f"n=720k V=25M w=16")
+    except Exception as e:  # noqa: BLE001 - toolchain may reject the kernel
+        RESULTS["tiled_kernels"] = f"FAIL {str(e)[:200]}"
+        print(f"tiled_kernels: FAIL {str(e)[:300]}", flush=True)
+
     print(json.dumps(RESULTS), flush=True)
 
 
