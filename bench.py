@@ -205,6 +205,10 @@ def _perf_files_changed_since(sha: str) -> int:
              "distributed_embeddings_tpu/training.py"],
             cwd=os.path.dirname(os.path.abspath(__file__)),
             capture_output=True, text=True, timeout=10)
+        if out.returncode != 0:
+            # unknown/garbage-collected sha, shallow clone: cannot
+            # determine — must NOT read as "no changes"
+            return -1
         return len([ln for ln in out.stdout.splitlines() if ln.strip()])
     except Exception:  # noqa: BLE001
         return -1

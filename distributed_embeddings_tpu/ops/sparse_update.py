@@ -191,9 +191,7 @@ def tiled_kernels_ok(ref_array) -> bool:
 
 
 def _use_tiled(ref_array) -> bool:
-    return (os.environ.get("DET_SCATTER_IMPL", "xla") == "tiled"
-            and jax.default_backend() == "tpu"
-            and tiled_kernels_ok(ref_array))
+    return _TILED_GATE.active(ref_array)
 
 
 def _tiled_route(strategy: str, ref_array) -> bool:

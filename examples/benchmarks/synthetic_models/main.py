@@ -50,6 +50,8 @@ def parse_args(argv=None):
                    help="--no-dp_input benchmarks the model-parallel input "
                         "path (feature-sharded data, no id exchange)")
     p.add_argument("--amp", action="store_true")
+    p.add_argument("--sparse_strategy", default="auto",
+                   choices=["auto", "sort", "dense", "tiled"])
     p.add_argument("--dense_grads", action="store_true",
                    help="use dense table gradients + optax instead of the "
                         "default sparse row-wise update path")
@@ -119,7 +121,8 @@ def main(argv=None):
         # [V, w] grads, no full-table optimizer pass)
         from distributed_embeddings_tpu.training import make_sparse_train_step
         init_fn, step_fn = make_sparse_train_step(
-            model, args.optimizer, lr=args.lr, donate=False)
+            model, args.optimizer, lr=args.lr, donate=False,
+            strategy=args.sparse_strategy)
         opt_state = init_fn(params)
     else:
         opt = {"sgd": optax.sgd, "adagrad": optax.adagrad,

@@ -53,6 +53,10 @@ def parse_args(argv=None):
     p.add_argument("--warmup_steps", type=int, default=8000)
     p.add_argument("--decay_start_step", type=int, default=48000)
     p.add_argument("--decay_steps", type=int, default=24000)
+    p.add_argument("--sparse_strategy", default="auto",
+                   choices=["auto", "sort", "dense", "tiled"],
+                   help="sparse aggregation strategy: tiled = the Pallas "
+                        "one-hot-matmul kernels (hardware-validated)")
     p.add_argument("--dense_grads", action="store_true",
                    help="dense table grads + optax instead of the default "
                         "sparse row-wise update path")
@@ -164,8 +168,9 @@ def main(argv=None):
     else:
         # production path: row-wise sparse embedding updates
         from distributed_embeddings_tpu.training import make_sparse_train_step
-        init_fn, step_fn = make_sparse_train_step(model, "sgd", lr=schedule,
-                                                  donate=False)
+        init_fn, step_fn = make_sparse_train_step(
+            model, "sgd", lr=schedule, donate=False,
+            strategy=args.sparse_strategy)
         opt_state = init_fn(params)
 
     # resume: restore params + optimizer state from the newest step under

@@ -152,6 +152,34 @@ def test_one_hot_auto_resolves_basic():
     assert dist.strategy.strategy == "basic"
 
 
+def test_ragged_exchange_auto_policy(monkeypatch):
+    """DET_RAGGED_EXCHANGE=auto (the round-4 default): per-group policy
+    picks the true-splits exchange on TPU iff padded volume > 1.5x true
+    ids; CPU always takes padded; '1'/'0' force."""
+    import types
+    specs = [(96, 8, "sum"), (50, 8, "sum")]
+    dist, _ = make_dist([(v, w) for v, w, _ in specs],
+                        input_max_hotness=[4, 4])
+
+    grp_pad = types.SimpleNamespace(rank_slots=[[0], [], [], [], [], [], [],
+                                                []], k=4, f_max=1)
+    grp_tight = types.SimpleNamespace(rank_slots=[[0]] * 8, k=4, f_max=1)
+    monkeypatch.delenv("DET_RAGGED_EXCHANGE", raising=False)
+    # CPU backend: auto never takes the ragged path
+    assert not dist._use_ragged_exchange(grp_pad, 8)
+    # force flags work regardless of backend
+    monkeypatch.setenv("DET_RAGGED_EXCHANGE", "1")
+    assert dist._use_ragged_exchange(grp_pad, 8)
+    assert not dist._use_ragged_exchange(grp_pad, 1)   # world 1: no exchange
+    monkeypatch.setenv("DET_RAGGED_EXCHANGE", "0")
+    assert not dist._use_ragged_exchange(grp_pad, 8)
+    # auto on a (mocked) TPU backend: ratio decides
+    monkeypatch.setenv("DET_RAGGED_EXCHANGE", "auto")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert dist._use_ragged_exchange(grp_pad, 8)       # 8x padding
+    assert not dist._use_ragged_exchange(grp_tight, 8)  # 1.0x padding
+
+
 def test_ragged_exchange_equivalence(monkeypatch):
     """DET_RAGGED_EXCHANGE=1 (true-splits exchange, CPU emulation) must be
     numerically identical to the padded exchange across mixed hotness,
