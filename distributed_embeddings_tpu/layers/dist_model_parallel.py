@@ -33,7 +33,9 @@ padding is bounded by per-destination feature-count imbalance, which the
 planner's placement strategies already minimize.
 """
 
+import logging
 import math
+import os
 from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -193,6 +195,48 @@ def _ragged_exchange_op(operand, output, in_off, send_sz, out_off, recv_sz,
     return jnp.where(valid[:, None], gathered, output)
 
 
+_TILED_INTERPRET_WARNED = [False]
+
+
+def _warn_tiled_interpret_once() -> None:
+    """DET_LOOKUP_PATH=tiled off-TPU runs the Pallas kernels in interpret
+    mode — orders of magnitude slower than the XLA path. Fine for the
+    equivalence tests that set it deliberately; say so once anywhere else
+    (ADVICE r4)."""
+    if _TILED_INTERPRET_WARNED[0]:
+        return
+    _TILED_INTERPRET_WARNED[0] = True
+    import warnings
+    warnings.warn(
+        "DET_LOOKUP_PATH=tiled on a non-TPU backend: the tiled Pallas "
+        "lookup runs in INTERPRET mode here (correct but very slow — "
+        "intended for tests). Unset DET_LOOKUP_PATH or run on TPU.",
+        RuntimeWarning, stacklevel=3)
+
+
+def _overrides_forward(cls) -> bool:
+    """True when a user embedding class carries its own forward semantics:
+    it overrides Embedding.__call__ and does not declare
+    `det_gather_semantics = True` (the opt-out for subclasses whose call is
+    still a plain gather+combine, e.g. config-only extensions)."""
+    from distributed_embeddings_tpu.layers.embedding import (
+        ConcatOneHotEmbedding, Embedding)
+    if cls is None or cls in (Embedding, ConcatOneHotEmbedding):
+        return False
+    if getattr(cls, "det_gather_semantics", False):
+        return False
+    # find the class that actually defines the instance __call__ — a
+    # config-only layer with NO __call__ (reference CustomEmbedding test
+    # contract, dist_model_parallel_test.py:48-66) has no forward of its
+    # own and keeps gather semantics; plain attribute lookup would wrongly
+    # return the metaclass's call here
+    for base in cls.__mro__:
+        if "__call__" in base.__dict__:
+            return base.__dict__["__call__"] is not Embedding.__dict__.get(
+                "__call__")
+    return False
+
+
 def _effective_weights(weights: Optional[jax.Array], k: int,
                        combiner: Optional[str]):
     """Rewrite a (weights, combiner) pair as an explicit weighted SUM:
@@ -290,6 +334,34 @@ class DistributedEmbedding:
                     "Try decreasing column_slice_threshold or device count.")
 
         self.plan: ShardedPlan = lower_strategy(self.strategy)
+        # Custom user layer classes (reference instantiates layer_class via
+        # from_config and calls ITS forward, :820-834). Tables whose class
+        # overrides the forward are honored per-table in the data-parallel
+        # group; in the fused model-parallel groups the bucket machinery
+        # executes plain gather+combine, so a custom forward there would be
+        # silently ignored — reject at plan time instead (VERDICT r4 item 6).
+        self._dp_custom_layers = {}
+        for j, gtid in enumerate(self.strategy.table_groups[0]):
+            cfg = self.strategy.global_configs[gtid]
+            if _overrides_forward(cfg.get("layer_class")):
+                kwargs = {k: v for k, v in cfg.items() if k != "layer_class"}
+                self._dp_custom_layers[j] = (
+                    cfg["layer_class"].from_config(kwargs))
+        for group in (1, 2):
+            for gtid in self.strategy.table_groups[group]:
+                cls = self.strategy.global_configs[gtid].get("layer_class")
+                if _overrides_forward(cls):
+                    raise ValueError(
+                        f"table {gtid}: custom embedding layer class "
+                        f"{cls.__name__} overrides __call__, but it was "
+                        "placed in a fused model-parallel group whose "
+                        "executor implements plain gather+combine — its "
+                        "custom forward would be silently ignored. Either "
+                        "(a) raise data_parallel_threshold so this table "
+                        "is data-parallel (custom forwards run per-table "
+                        "there), or (b) set `det_gather_semantics = True` "
+                        "on the class to assert its forward is equivalent "
+                        "to a plain (weighted) gather+combine.")
         self.input_max_hotness = (list(input_max_hotness)
                                   if input_max_hotness is not None else None)
         self._n_inputs = len(self.strategy.input_table_map)
@@ -320,6 +392,9 @@ class DistributedEmbedding:
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype is not None else None)
         self._groups_cache: dict = {}
+        # (bucket, f_max, k) -> "ragged"|"padded": the exchange path each
+        # group actually took (filled at trace time, see _use_ragged_exchange)
+        self._exchange_path_taken: dict = {}
         self._host_fn_cache: dict = {}
         # physical host offload: buckets past the gpu_embedding_size budget
         # live in pinned host memory (the reference's /CPU:0 placement,
@@ -616,10 +691,13 @@ class DistributedEmbedding:
                 "bucket": g.bucket, "hotness": g.k, "f_max": g.f_max,
                 "features_per_rank": [len(s) for s in g.rank_slots],
                 "true_ids": true_ids, "exchanged_ids": ex_ids,
+                "path_taken": self._exchange_path_taken.get(
+                    (g.bucket, g.f_max, g.k)),
             })
         return {"groups": report, "true_ids": true_tot,
                 "exchanged_ids": ex_tot,
-                "ratio": (ex_tot / true_tot) if true_tot else 1.0}
+                "ratio": (ex_tot / true_tot) if true_tot else 1.0,
+                "exchange_paths": dict(self._exchange_path_taken)}
 
     def _group_lookup(self, table: jax.Array, ids: jax.Array,
                       weights: Optional[jax.Array],
@@ -634,19 +712,23 @@ class DistributedEmbedding:
         which XLA fuses. (Offloaded buckets never reach here — their lookups
         run host-side in `_host_group_exchange`.)
         """
-        import os
         b_sz, f, k = ids.shape
         path = os.environ.get("DET_LOOKUP_PATH", "auto")
         if combiner is None and k == 1 and path in ("pallas", "tiled"):
             combiner = "sum"     # identical result at hotness 1
-        if path == "tiled" and combiner in ("sum", "mean"):
+        if (path == "tiled" and combiner in ("sum", "mean")
+                and self.use_custom_kernel):
             # round-4 tiled one-hot-matmul gather (ops/pallas_tiled.py):
             # sort + block-streamed table walk, replacing the ~22 ns/row
             # descriptor-bound XLA row gather. Compiled use requires the
             # eager hardware validation (prevalidate_active_impl); off-TPU
-            # it runs in interpret mode (tests)
+            # it runs in interpret mode (tests). Gated on use_custom_kernel
+            # like the pallas path — the constructor opt-out wins over the
+            # env knob (ADVICE r4).
             from distributed_embeddings_tpu.ops import (pallas_tiled,
                                                         sparse_update)
+            if not pallas_lookup.is_tpu_backend():
+                _warn_tiled_interpret_once()
             if sparse_update.tiled_kernels_ok(table):
                 w = (weights if weights is not None
                      else jnp.ones((b_sz, f, k), jnp.float32))
@@ -735,8 +817,31 @@ class DistributedEmbedding:
         # ---- data-parallel tables: plain local lookup on replicated params
         dp_outs = []
         for j, (ids, weights) in enumerate(dp_in):
-            cfg = strat.dp_configs[strat.map_groups[0][j]]
-            table = dp_params[strat.map_groups[0][j]]
+            t_dp = strat.map_groups[0][j]
+            cfg = strat.dp_configs[t_dp]
+            table = dp_params[t_dp]
+            layer = self._dp_custom_layers.get(t_dp)
+            if layer is not None:
+                # custom layer_class: run the USER's forward on the prepared
+                # [B_l, k] ids (reference :820-834 semantics). Contract:
+                # params stay {"embeddings": [V, w]}; output rank must match
+                # the stock layer ([B, w] with a combiner, [B, k, w] without)
+                # so the shard_map out_specs hold.
+                if weights is not None:
+                    raise NotImplementedError(
+                        f"dp table {t_dp}: (ids, weights) inputs are not "
+                        "supported for custom embedding layer classes — "
+                        "the layer's own __call__ defines its semantics")
+                out = layer({"embeddings": table}, ids)
+                want_rank = 2 if cfg.get("combiner") else 3
+                if out.ndim != want_rank:
+                    raise ValueError(
+                        f"dp table {t_dp}: custom layer forward returned "
+                        f"rank-{out.ndim} output, expected rank "
+                        f"{want_rank} ([batch, width] with a combiner, "
+                        "[batch, hotness, width] without)")
+                dp_outs.append(out)
+                continue
             emb = self._cast(jnp.take(table, ids, axis=0))   # [B_l, k, w]
             dp_outs.append(_combine(emb, weights, cfg.get("combiner")))
 
@@ -801,15 +906,27 @@ class DistributedEmbedding:
         as pending in docs/round4_notes.md."""
         if world <= 1:
             return False
-        import os as _os
-        mode = _os.environ.get("DET_RAGGED_EXCHANGE", "auto")
+        mode = os.environ.get("DET_RAGGED_EXCHANGE", "auto")
         if mode in ("0", "1"):
-            return mode == "1"
-        if jax.default_backend() != "tpu":
-            return False      # CPU emulation path is for tests only
-        true_ids = sum(len(s) for s in grp.rank_slots) * grp.k
-        padded_ids = world * grp.f_max * grp.k
-        return padded_ids > 1.5 * max(true_ids, 1)
+            ragged = mode == "1"
+        elif jax.default_backend() != "tpu":
+            ragged = False    # CPU emulation path is for tests only
+        else:
+            true_ids = sum(len(s) for s in grp.rank_slots) * grp.k
+            padded_ids = world * grp.f_max * grp.k
+            ragged = padded_ids > 1.5 * max(true_ids, 1)
+        # attributable perf (ADVICE r4): record the decision per group so a
+        # hardware regression can be traced to the path that ran — surfaced
+        # in exchange_padding_report()["exchange_paths"] and the debug log
+        decision = "ragged" if ragged else "padded"
+        key = (grp.bucket, grp.f_max, grp.k)
+        if self._exchange_path_taken.get(key) != decision:
+            self._exchange_path_taken[key] = decision
+            logging.getLogger(__name__).debug(
+                "exchange group bucket=%d f_max=%d k=%d -> %s "
+                "(DET_RAGGED_EXCHANGE=%s)", grp.bucket, grp.f_max, grp.k,
+                decision, mode)
+        return ragged
 
     def _padded_id_exchange(self, grp, ids, w, world, blocal):
         """Fixed-shape dp->mp id (+weight) exchange: dense
@@ -1759,13 +1876,25 @@ class DistributedEmbedding:
                           opt: SparseOptimizer, lr_value=None):
         """Apply deduped rows to an offloaded bucket's host-resident table.
 
-        Tries the native path first — a top-level jit whose outputs are
-        pinned host memory, with the row scatter in a compute_on host region
-        (zero full-table traffic). Where the backend cannot partition host
-        placements (XLA:CPU SPMD, 'Side-effect ops cannot be replicated'),
-        falls back to a device round-trip: pull the bucket shard to device,
-        update, place back — correct, but costs a full-bucket transfer per
-        step (acceptable for tests; TPU takes the native path).
+        Three implementations, best-available (force with DET_HOST_APPLY=
+        native|pershard|roundtrip):
+
+        * 'native' — a top-level jit whose outputs are pinned host memory,
+          with the row scatter in a compute_on host region (zero full-table
+          traffic, overlappable with device work). Preferred where the
+          backend partitions host placements.
+        * 'pershard' — XLA-free: per local shard, fetch ONLY the deduped
+          update rows off-device (the native wire volume) and apply them to
+          the pinned-host table/state buffers with the C++/numpy kernels
+          (ops/sparse_update.host_apply_rows_inplace, native/host_apply.cpp).
+          Sidesteps the SPMD partitioner entirely — there is no XLA program
+          to partition — so it works at any world size on any backend.
+          This is the reference's design point: host tables update with host
+          ops (reference dist_model_parallel.py:829-831, :971-1017).
+        * 'roundtrip' — pull the bucket shard to device, update, place back;
+          a full-bucket transfer per step. Kept only as the last resort for
+          non-f32 offloaded tables (the host kernels are f32) and for
+          hardware A/B (tools/tpu_offload_probe.py).
         """
         apply_fn = sparse_update_ops.HOST_SPARSE_APPLY[opt.kind]
         hp = dict(opt.hp)
@@ -1829,12 +1958,40 @@ class DistributedEmbedding:
                                 x, host_sh if x.ndim >= 1 else scalar_sh),
                             new_s))
 
-            mode = self._host_fn_cache.get(mode_key)
+            f32_ok = (table_h.dtype == jnp.float32 and all(
+                x.dtype == jnp.float32
+                for x in jax.tree.leaves(state_h)
+                if getattr(x, "ndim", 0) >= 1))
+
+            def run_pershard(table_h, state_h, rep, sums, valid, lr_a):
+                return self._host_pershard_apply(
+                    opt.kind, kw, table_h, state_h, rep, sums, valid, lr_a)
+
+            forced = os.environ.get("DET_HOST_APPLY", "auto")
+            if forced == "pershard" and not f32_ok:
+                # the forced knob must not reach the f32-only host kernels
+                # with a non-f32 bucket (heap corruption, not an error)
+                import warnings
+                warnings.warn(
+                    f"DET_HOST_APPLY=pershard ignored for offloaded bucket "
+                    f"{b}: the host kernels are float32-only and this "
+                    "bucket is not; using the device round-trip",
+                    RuntimeWarning, stacklevel=2)
+                forced = "roundtrip"
+            mode = (forced if forced in ("native", "pershard", "roundtrip")
+                    else self._host_fn_cache.get(mode_key))
+            if mode in ("native", "pershard", "roundtrip"):
+                # forced modes must be visible to host_apply_modes() too
+                self._host_fn_cache[mode_key] = mode
             if mode == "roundtrip":
                 fn = run_roundtrip
             elif mode == "native":
                 fn = native
+            elif mode == "pershard":
+                fn = run_pershard
             else:
+                fallback = run_pershard if f32_ok else run_roundtrip
+
                 def probe(table_h, state_h, rep, sums, valid, lr_a):
                     try:
                         out = native(table_h, state_h, rep, sums, valid,
@@ -1843,32 +2000,111 @@ class DistributedEmbedding:
                         self._host_fn_cache[key] = native
                         return out
                     except jax.errors.JaxRuntimeError as e:
-                        # only the known backend gap (SPMD partitioners that
-                        # cannot place host-memory outputs) falls back; the
-                        # fallback pays a full-bucket device round-trip per
-                        # step, so say so once. XLA:CPU phrases it two ways
-                        # depending on whether the offending op is an array
-                        # ("cannot be replicated") or a scalar placement
-                        # annotation ("Side-effect HLO must have sharding").
+                        # only the known backend gaps fall back: SPMD
+                        # partitioners that cannot place host-memory
+                        # outputs (two phrasings depending on whether the
+                        # offender is an array or a scalar placement
+                        # annotation) and backends with no host-placement
+                        # custom-call at all (XLA:CPU single-device).
                         if ("cannot be replicated" not in str(e)
                                 and "Side-effect HLO must have sharding"
-                                not in str(e)):
+                                not in str(e)
+                                and "annotate_device_placement" not in
+                                str(e)):
                             raise
-                        import warnings
-                        warnings.warn(
-                            "host-memory sparse apply unsupported on this "
-                            "backend (XLA: side-effect ops cannot be "
-                            "replicated); falling back to a device "
-                            "round-trip per step for offloaded bucket "
-                            f"{b}", RuntimeWarning, stacklevel=2)
-                        self._host_fn_cache[mode_key] = "roundtrip"
-                        self._host_fn_cache[key] = run_roundtrip
-                        return run_roundtrip(table_h, state_h, rep, sums,
-                                             valid, lr_a)
+                        if fallback is run_roundtrip:
+                            import warnings
+                            warnings.warn(
+                                "host-memory sparse apply unsupported on "
+                                "this backend and the bucket is not f32; "
+                                "falling back to a device round-trip per "
+                                f"step for offloaded bucket {b}",
+                                RuntimeWarning, stacklevel=2)
+                            self._host_fn_cache[mode_key] = "roundtrip"
+                        else:
+                            logging.getLogger(__name__).info(
+                                "offloaded bucket %d: backend cannot "
+                                "partition host-placement outputs; using "
+                                "the XLA-free per-shard host apply "
+                                "(row-only wire traffic)", b)
+                            self._host_fn_cache[mode_key] = "pershard"
+                        self._host_fn_cache[key] = fallback
+                        return fallback(table_h, state_h, rep, sums,
+                                        valid, lr_a)
                 fn = probe
             self._host_fn_cache.setdefault(key, fn)
         return fn(table_h, state_h, rep, sums, valid,
                   jnp.asarray(lr_in, jnp.float32))
+
+    def host_apply_modes(self) -> dict:
+        """{(bucket, optimizer_kind): 'native'|'pershard'|'roundtrip'} for
+        every offloaded apply that has run (or been env-forced) in this
+        process — keyed per BUCKET so a round-trip fallback on one bucket is
+        never masked by another bucket's mode."""
+        return {(k[1], k[2]): v for k, v in self._host_fn_cache.items()
+                if isinstance(k, tuple) and k[0] == "host_apply_mode"}
+
+    def _host_pershard_apply(self, kind, kw, table_h, state_h, rep, sums,
+                             valid, lr_a):
+        """XLA-free offloaded apply: for each LOCAL shard of the stacked
+        pinned-host bucket, fetch that shard's deduped update rows from
+        device (rows only — the bucket itself never crosses the wire),
+        update the host buffers in place with the C++/numpy row kernels,
+        and reassemble the global arrays shard-by-shard. Works at any world
+        size on any backend because no XLA program ever sees the host
+        placement. Scalar state leaves (adam's step count) increment here,
+        mirroring host_sparse_adam's `count + 1`."""
+        lr = float(jax.device_get(lr_a))
+
+        def by_device(x):
+            return {s.device: s.data for s in x.addressable_shards}
+
+        t_shards = list(table_h.addressable_shards)
+        rep_d, sums_d, valid_d = by_device(rep), by_device(sums), \
+            by_device(valid)
+        arr_state = [x for x in state_h if getattr(x, "ndim", 0) >= 1]
+        state_d = [by_device(x) for x in arr_state]
+        scalar_after = {
+            i: jax.device_get(x) + (1 if kind == "adam" else 0)
+            for i, x in enumerate(state_h)
+            if getattr(x, "ndim", 0) == 0}
+
+        new_t, new_s = [], [[] for _ in arr_state]
+        for sh in t_shards:
+            dev = sh.device
+            t_np = np.array(sh.data)            # host->host copy, mutable
+            s_nps = [np.array(sd[dev]) for sd in state_d]
+            rep_np = np.asarray(rep_d[dev])     # rows only cross the wire
+            sums_np = np.asarray(sums_d[dev])
+            valid_np = np.asarray(valid_d[dev])
+            for j in range(t_np.shape[0]):      # world slices on this shard
+                if kind == "adam":
+                    st = (s_nps[0][j], s_nps[1][j],
+                          next(iter(scalar_after.values())))
+                else:
+                    st = tuple(s[j] for s in s_nps)
+                sparse_update_ops.host_apply_rows_inplace(
+                    kind, t_np[j], st, rep_np[j], sums_np[j], valid_np[j],
+                    lr, **kw)
+            new_t.append(jax.device_put(t_np, sh.data.sharding))
+            for i, s_np in enumerate(s_nps):
+                new_s[i].append(
+                    jax.device_put(s_np, state_d[i][dev].sharding))
+
+        def assemble(global_ref, shards):
+            return jax.make_array_from_single_device_arrays(
+                global_ref.shape, global_ref.sharding, shards)
+
+        out_table = assemble(table_h, new_t)
+        out_state, ai = [], 0
+        for i, x in enumerate(state_h):
+            if getattr(x, "ndim", 0) >= 1:
+                out_state.append(assemble(x, new_s[ai]))
+                ai += 1
+            else:
+                out_state.append(jax.device_put(
+                    jnp.asarray(scalar_after[i], dtype=x.dtype), x.sharding))
+        return out_table, tuple(out_state)
 
     @staticmethod
     def _restore_shape(out, p: _PreparedInput, combiner, width):
@@ -1909,6 +2145,35 @@ class DistributedEmbedding:
                     return np.asarray(sh.data)[rank - start]
         return np.asarray(arr)[rank]
 
+    # reference parity: get_weights chunks its collectives so no single
+    # gather exceeds ~128M elements (reference dist_model_parallel.py
+    # _split_1d + :1024-1089 bounds both the 2e9-element collective limit
+    # and peak memory). Overridable for tests / small-RAM hosts.
+    GATHER_CHUNK_ELEMS = int(os.environ.get("DET_GATHER_CHUNK_ELEMS",
+                                            128 * 1024 * 1024))
+
+    def _gather_global_chunked(self, arr: jax.Array) -> np.ndarray:
+        """Replicate a non-fully-addressable stacked param host-side in
+        row chunks: each collective moves (and each device holds) at most
+        ~GATHER_CHUNK_ELEMS elements, so the peak device/temp footprint is
+        O(chunk) + the unavoidable host result, never a second full bucket
+        (VERDICT r4 item 5; the single-call process_allgather it replaces
+        replicated the ENTIRE stacked bucket on every device first)."""
+        from jax.experimental import multihost_utils
+        world = max(int(arr.shape[0]), 1)
+        rows = int(arr.shape[1]) if arr.ndim > 1 else 1
+        tail = int(np.prod(arr.shape[2:])) if arr.ndim > 2 else 1
+        chunk = max(1, self.GATHER_CHUNK_ELEMS // max(world * tail, 1))
+        if arr.ndim < 2 or chunk >= rows:
+            return np.asarray(
+                multihost_utils.process_allgather(arr, tiled=True))
+        out = np.empty(arr.shape, dtype=arr.dtype)
+        for r0 in range(0, rows, chunk):
+            r1 = min(rows, r0 + chunk)
+            out[:, r0:r1] = np.asarray(multihost_utils.process_allgather(
+                arr[:, r0:r1], tiled=True))
+        return out
+
     def get_weights(self, params, all_ranks: bool = False) -> List[np.ndarray]:
         """Reassemble global per-table weights in original table order
         (reference get_weights :1139-1162), reading device shards one at a
@@ -1921,12 +2186,10 @@ class DistributedEmbedding:
         del all_ranks  # SPMD: every process sees the global jax.Array
         cache: dict = {}
         if self.mesh is not None and jax.process_count() > 1:
-            from jax.experimental import multihost_utils
             for arr in list(params["tp"]) + list(params["row"]):
                 if (hasattr(arr, "is_fully_addressable")
                         and not arr.is_fully_addressable):
-                    cache[id(arr)] = np.asarray(
-                        multihost_utils.process_allgather(arr, tiled=True))
+                    cache[id(arr)] = self._gather_global_chunked(arr)
         strat = self.strategy
         n = len(strat.global_configs)
         out: List[Optional[np.ndarray]] = [None] * n

@@ -16,7 +16,8 @@ def load():
     with _LOCK:
         if _LIB is not None:
             return _LIB
-        srcs = [os.path.join(_DIR, f) for f in ("hashmap.cpp", "io.cpp")]
+        srcs = [os.path.join(_DIR, f)
+                for f in ("hashmap.cpp", "io.cpp", "host_apply.cpp")]
         have_so = os.path.exists(_SO)
         # missing sources (stripped install) are NOT stale — use the .so
         stale = (not have_so
@@ -63,6 +64,16 @@ def load():
         lib.pf_wait.argtypes = [p, p]
         lib.pf_read.restype = i64
         lib.pf_read.argtypes = [p, i64, i64, i64, ctypes.c_void_p]
+
+        # a prebuilt .so from before host_apply.cpp may lack these symbols
+        # (stripped install with no g++): keep il_*/pf_* usable and let the
+        # host-apply wrapper fall back to numpy
+        if hasattr(lib, "ha_sgd"):
+            f32 = ctypes.c_float
+            lib.ha_sgd.argtypes = [p, i64, p, p, p, i64, f32]
+            lib.ha_adagrad.argtypes = [p, p, i64, p, p, p, i64, f32, f32]
+            lib.ha_adam.argtypes = [p, p, p, i64, p, p, p, i64, f32, f32,
+                                    f32, f32, f32, f32]
 
         _LIB = lib
         return _LIB

@@ -99,8 +99,28 @@ class _KernelGate:
                 or jax.default_backend() != "tpu"):
             return False
         if isinstance(ref_array, jax.core.Tracer):
+            if self.verdict is None:
+                self._warn_unvalidated_trace()
             return bool(self.verdict)
         return self.prevalidate()
+
+    def _warn_unvalidated_trace(self) -> None:
+        """The env knob requests this kernel but prevalidation never ran
+        before tracing — the request is quietly inert (ADVICE r4). Say so
+        once: the fix is calling prevalidate_active_impl() (or
+        make_sparse_train_step / DistributedEmbedding construction, which
+        call it) BEFORE the jit trace, or setting the knob earlier."""
+        if getattr(self, "_trace_warned", False):
+            return
+        self._trace_warned = True
+        import warnings
+        warnings.warn(
+            f"{self.what} requested, but the kernel was never validated on "
+            "this backend before the jit trace — falling back to the XLA "
+            "path. Call distributed_embeddings_tpu.ops.sparse_update."
+            "prevalidate_active_impl() before tracing (set the env knob "
+            "before constructing the train step).", RuntimeWarning,
+            stacklevel=4)
 
 
 def _validate_tiled() -> bool:
@@ -186,6 +206,8 @@ def tiled_kernels_ok(ref_array) -> bool:
     if jax.default_backend() != "tpu":
         return True
     if isinstance(ref_array, jax.core.Tracer):
+        if _TILED_GATE.verdict is None:
+            _TILED_GATE._warn_unvalidated_trace()
         return bool(_TILED_GATE.verdict)
     return _TILED_GATE.prevalidate()
 
@@ -564,6 +586,97 @@ def host_sparse_adam(table, state, rep, sums, valid, lr, b1: float = 0.9,
 
 HOST_SPARSE_APPLY = {"sgd": host_sparse_sgd, "adagrad": host_sparse_adagrad,
                      "adam": host_sparse_adam}
+
+
+def host_apply_rows_inplace(kind: str, table, state, rep, sums, valid, lr,
+                            **hp) -> None:
+    """Apply one shard's deduped update rows to host-resident numpy buffers
+    IN PLACE — the XLA-free twin of HOST_SPARSE_APPLY (same args, same
+    numerics) used by the per-shard offload apply, where the table never
+    enters an XLA program (see host_apply.cpp for why). `table` and the
+    array leaves of `state` are mutated; adam's scalar count must be
+    incremented by the CALLER (mirroring `count + 1` in host_sparse_adam).
+    Native C++ kernels when buildable, numpy otherwise."""
+    import numpy as np
+
+    bad = [a.dtype for a in (table, *(s for s in state
+                                      if getattr(s, "ndim", 0) >= 1))
+           if a.dtype != np.float32]
+    if bad:
+        raise TypeError(
+            f"host_apply_rows_inplace is float32-only, got {bad}; use the "
+            "roundtrip offload apply (DET_HOST_APPLY=roundtrip) for "
+            "non-f32 buckets")
+    n, w = sums.shape
+    lr = float(lr)
+    rep = np.ascontiguousarray(rep, dtype=np.int32)
+    sums = np.ascontiguousarray(sums, dtype=np.float32)
+    valid = np.ascontiguousarray(valid, dtype=np.float32)
+    lib = None
+    try:
+        from ..native import loader as _native_loader
+        lib = _native_loader.load()
+        if not hasattr(lib, "ha_sgd"):   # prebuilt .so without the kernels
+            lib = None
+    except Exception:            # no g++ and no prebuilt .so: numpy fallback
+        lib = None
+    if lib is not None:
+        import ctypes
+
+        def ptr(a):
+            return ctypes.c_void_p(a.ctypes.data)
+
+        if kind == "sgd":
+            lib.ha_sgd(ptr(table), w, ptr(rep), ptr(sums), ptr(valid), n, lr)
+        elif kind == "adagrad":
+            (acc,) = state
+            lib.ha_adagrad(ptr(table), ptr(acc), w, ptr(rep), ptr(sums),
+                           ptr(valid), n, lr, float(hp.get("eps", 1e-10)))
+        elif kind == "adam":
+            mu, nu, count = state
+            b1 = float(hp.get("b1", 0.9))
+            b2 = float(hp.get("b2", 0.999))
+            cf = float(count)             # already incremented by the caller
+            lib.ha_adam(ptr(table), ptr(mu), ptr(nu), w, ptr(rep), ptr(sums),
+                        ptr(valid), n, lr, b1, b2,
+                        np.float32(1.0) - np.float32(b1) ** np.float32(cf),
+                        np.float32(1.0) - np.float32(b2) ** np.float32(cf),
+                        float(hp.get("eps", 1e-8)))
+        else:
+            raise NotImplementedError(
+                f"no host-memory apply rule for optimizer {kind!r}")
+        return
+
+    ok = valid > 0.0              # invalid slots alias row 0 with zero sums
+    r = rep[ok]
+    s = sums[ok]
+    if kind == "sgd":
+        np.add.at(table, r, (-lr * s).astype(np.float32))
+    elif kind == "adagrad":
+        (acc,) = state
+        eps = np.float32(hp.get("eps", 1e-10))
+        np.add.at(acc, r, s * s)
+        np.add.at(table, r,
+                  (-lr * s / np.sqrt(acc[r] + eps)).astype(np.float32))
+    elif kind == "adam":
+        mu, nu, count = state
+        b1 = np.float32(hp.get("b1", 0.9))
+        b2 = np.float32(hp.get("b2", 0.999))
+        eps = np.float32(hp.get("eps", 1e-8))
+        cf = np.float32(count)
+        c1 = np.float32(1.0) - b1 ** cf
+        c2 = np.float32(1.0) - b2 ** cf
+        mu_new = b1 * mu[r] + (np.float32(1.0) - b1) * s
+        nu_new = b2 * nu[r] + (np.float32(1.0) - b2) * s * s
+        mu[r] = mu_new            # valid reps are unique: plain set is exact
+        nu[r] = nu_new
+        np.add.at(
+            table, r,
+            (-lr * (mu_new / c1) / (np.sqrt(nu_new / c2) + eps)).astype(
+                np.float32))
+    else:
+        raise NotImplementedError(
+            f"no host-memory apply rule for optimizer {kind!r}")
 
 
 # ------------------------------------------------- optimizer description

@@ -138,6 +138,76 @@ def test_offload_sparse_train_matches_device(optimizer):
                                    err_msg=f"table {t}")
 
 
+@pytest.mark.parametrize("optimizer", ["sgd", "adagrad", "adam"])
+def test_offload_apply_no_roundtrip_warning(optimizer):
+    """VERDICT r4 item 3: at world>1 the offloaded apply must NOT fall back
+    to the full-bucket device round-trip. Where the backend cannot partition
+    host placements (this CPU mesh), the XLA-free per-shard host apply takes
+    over silently — row-only wire traffic, no RuntimeWarning."""
+    import warnings
+
+    rng = np.random.RandomState(2)
+    mesh = create_mesh(jax.devices()[:8])
+    weights = [rng.randn(v, w).astype(np.float32) * 0.1 for v, w, _ in SPECS]
+    model = TinyModel(SPECS, mesh, gpu_embedding_size=BUDGET)
+    init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.05,
+                                              strategy="sort")
+    params = {"embedding": model.embedding.set_weights(weights),
+              "head": {"w": jnp.asarray(
+                  np.random.RandomState(7).randn(
+                      sum(w for _, w, _ in SPECS), 1).astype(np.float32))}}
+    opt_state = init_fn(params)
+    rng2 = np.random.RandomState(3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        for _ in range(2):
+            cats = [jnp.asarray(rng2.randint(0, v, size=(BATCH, 2)))
+                    for v, _, _ in SPECS]
+            labels = jnp.asarray(rng2.randn(BATCH).astype(np.float32))
+            params, opt_state, _ = step_fn(params, opt_state,
+                                           jnp.zeros((BATCH, 1)), cats,
+                                           labels)
+    modes = model.embedding.host_apply_modes()
+    assert modes and all(m in ("native", "pershard") for m in modes.values()), \
+        modes
+
+
+def test_offload_apply_forced_modes_agree(monkeypatch):
+    """The three DET_HOST_APPLY implementations are numerically
+    interchangeable: forced pershard == forced roundtrip, step for step."""
+    rng = np.random.RandomState(4)
+    mesh = create_mesh(jax.devices()[:8])
+    weights = [rng.randn(v, w).astype(np.float32) * 0.1 for v, w, _ in SPECS]
+
+    def run(mode):
+        monkeypatch.setenv("DET_HOST_APPLY", mode)
+        model = TinyModel(SPECS, mesh, gpu_embedding_size=BUDGET)
+        init_fn, step_fn = make_sparse_train_step(model, "adam", lr=0.05,
+                                                  strategy="sort")
+        params = {"embedding": model.embedding.set_weights(weights),
+                  "head": {"w": jnp.asarray(
+                      np.random.RandomState(7).randn(
+                          sum(w for _, w, _ in SPECS), 1).astype(
+                              np.float32))}}
+        opt_state = init_fn(params)
+        rng2 = np.random.RandomState(6)
+        for _ in range(3):
+            cats = [jnp.asarray(rng2.randint(0, v, size=(BATCH, 2)))
+                    for v, _, _ in SPECS]
+            labels = jnp.asarray(rng2.randn(BATCH).astype(np.float32))
+            params, opt_state, loss = step_fn(params, opt_state,
+                                              jnp.zeros((BATCH, 1)), cats,
+                                              labels)
+        return float(loss), model.embedding.get_weights(params["embedding"])
+
+    l_rt, w_rt = run("roundtrip")
+    l_ps, w_ps = run("pershard")
+    np.testing.assert_allclose(l_ps, l_rt, rtol=1e-5, atol=1e-6)
+    for t, (a, b) in enumerate(zip(w_rt, w_ps)):
+        np.testing.assert_allclose(b, a, rtol=2e-5, atol=2e-5,
+                                   err_msg=f"table {t}")
+
+
 def test_unknown_host_apply_rejected():
     """Only optimizers with a host apply rule may touch offloaded buckets
     (adam gained one this round; a fake kind still raises)."""

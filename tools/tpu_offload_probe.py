@@ -140,8 +140,32 @@ def main():
         RESULTS[f"{optimizer}_step_ms_offloaded"] = round(ms_off, 3)
         RESULTS[f"{optimizer}_step_ms_device"] = round(ms_dev, 3)
         RESULTS[f"{optimizer}_raw"] = {"off": raw_o, "dev": raw_d}
+        modes = off_model.embedding.host_apply_modes()
+        RESULTS[f"{optimizer}_apply_mode"] = sorted(
+            f"b{b}:{m}" for (b, _k), m in modes.items())
         print(f"{optimizer}: offloaded {ms_off:.2f} ms/step vs device "
-              f"{ms_dev:.2f} ms/step", flush=True)
+              f"{ms_dev:.2f} ms/step mode={RESULTS[f'{optimizer}_apply_mode']}",
+              flush=True)
+
+        # A/B: force the XLA-free per-shard apply (the pod answer where the
+        # partitioner rejects host placements) against whatever auto chose
+        os.environ["DET_HOST_APPLY"] = "pershard"
+        try:
+            ps_model = build(150_000 * 16)
+            p_ps = {"embedding": ps_model.embedding.set_weights(weights)}
+            pi, pstep = make_sparse_train_step(ps_model, optimizer, lr=0.05)
+            sp = pi(p_ps)
+            p_ps, sp, lp = pstep(p_ps, sp, numerical, cats, labels)
+            RESULTS[f"{optimizer}_pershard_loss_match"] = bool(
+                abs(float(lp) - ld) < 1e-4)
+            ms_ps, raw_p = time_steps(pstep, p_ps, sp)
+            RESULTS[f"{optimizer}_step_ms_pershard"] = round(ms_ps, 3)
+            RESULTS[f"{optimizer}_pershard_raw"] = raw_p
+            print(f"{optimizer}: pershard {ms_ps:.2f} ms/step", flush=True)
+        except Exception as e:  # noqa: BLE001
+            RESULTS[f"{optimizer}_pershard_error"] = str(e)[:300]
+        finally:
+            os.environ.pop("DET_HOST_APPLY", None)
 
     print(json.dumps(RESULTS), flush=True)
 
