@@ -214,6 +214,15 @@ def _warn_tiled_interpret_once() -> None:
         RuntimeWarning, stacklevel=3)
 
 
+# jit-of-named-function with static bounds: cached across chunks, calls
+# and buckets (a fresh lambda per chunk would re-trace+compile every time)
+def _slice_rows(a, lo: int, hi: int):
+    return lax.slice_in_dim(a, lo, hi, axis=1)
+
+
+_slice_rows_jit = jax.jit(_slice_rows, static_argnums=(1, 2))
+
+
 def _overrides_forward(cls) -> bool:
     """True when a user embedding class carries its own forward semantics:
     it overrides Embedding.__call__ and does not declare
@@ -2164,14 +2173,30 @@ class DistributedEmbedding:
         rows = int(arr.shape[1]) if arr.ndim > 1 else 1
         tail = int(np.prod(arr.shape[2:])) if arr.ndim > 2 else 1
         chunk = max(1, self.GATHER_CHUNK_ELEMS // max(world * tail, 1))
-        if arr.ndim < 2 or chunk >= rows:
+        # offloaded (pinned-host) buckets: process_allgather's replicated
+        # jit cannot consume host-placement inputs (the same partitioner
+        # RET_CHECK the train-path pershard apply sidesteps). A jit SLICE of
+        # the host input lands in device memory partitioned — so each chunk
+        # is moved host->device per-shard first, and only device arrays ever
+        # meet the collective. Chunking bounds the device temp to O(chunk).
+        host_kind = getattr(arr.sharding, "memory_kind", "device") not in (
+            None, "device")
+        if arr.ndim < 2:
+            if host_kind:
+                arr = jax.device_put(
+                    arr, arr.sharding.with_memory_kind("device"))
+            return np.asarray(
+                multihost_utils.process_allgather(arr, tiled=True))
+        if chunk >= rows and not host_kind:
             return np.asarray(
                 multihost_utils.process_allgather(arr, tiled=True))
         out = np.empty(arr.shape, dtype=arr.dtype)
         for r0 in range(0, rows, chunk):
             r1 = min(rows, r0 + chunk)
-            out[:, r0:r1] = np.asarray(multihost_utils.process_allgather(
-                arr[:, r0:r1], tiled=True))
+            piece = (_slice_rows_jit(arr, r0, r1) if host_kind
+                     else arr[:, r0:r1])
+            out[:, r0:r1] = np.asarray(
+                multihost_utils.process_allgather(piece, tiled=True))
         return out
 
     def get_weights(self, params, all_ranks: bool = False) -> List[np.ndarray]:

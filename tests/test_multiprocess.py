@@ -32,7 +32,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run(nproc: int, local_devices: int, out: str, ckpt=None, timeout=420):
+def _run(nproc: int, local_devices: int, out: str, ckpt=None, timeout=600):
     port = _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own device count
