@@ -390,10 +390,9 @@ class DistributedEmbedding:
         # never call make_sparse_train_step (inference, dense-grad optax):
         # __init__ runs eagerly, so validate the kernels on the chip here —
         # traced forwards then consult the cached verdict
-        import os as _os
-        if _os.environ.get("DET_LOOKUP_PATH") == "tiled":
-            from distributed_embeddings_tpu.ops.sparse_update import (
-                prevalidate_active_impl)
+        from distributed_embeddings_tpu.ops.sparse_update import (
+            measured_default, prevalidate_active_impl)
+        if measured_default("DET_LOOKUP_PATH", "auto") == "tiled":
             prevalidate_active_impl()
         # mixed precision (reference tests' mixed_precision_policy,
         # dist_model_parallel_test.py:30-34): params stay fp32, the lookup
@@ -722,7 +721,7 @@ class DistributedEmbedding:
         run host-side in `_host_group_exchange`.)
         """
         b_sz, f, k = ids.shape
-        path = os.environ.get("DET_LOOKUP_PATH", "auto")
+        path = sparse_update_ops.measured_default("DET_LOOKUP_PATH", "auto")
         if combiner is None and k == 1 and path in ("pallas", "tiled"):
             combiner = "sum"     # identical result at hotness 1
         if (path == "tiled" and combiner in ("sum", "mean")

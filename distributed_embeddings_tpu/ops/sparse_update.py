@@ -46,6 +46,43 @@ DENSE_ELEMS_MAX = int(os.environ.get("DET_SPARSE_DENSE_MAX",
                                      16 * 1024 * 1024))
 
 
+_MEASURED_DEFAULTS: Optional[dict] = None
+
+
+def measured_default(knob: str, fallback: str) -> str:
+    """Hardware-measured default for a DET_* dispatch knob.
+
+    bench.py's A/B arms write the winning knob values (with provenance) to
+    tools/measured_defaults.json when they win on the real chip — decision
+    rule 5 of docs/perf_model.md executed by machinery instead of a human
+    editing defaults. Env vars always override; the file is consulted ONLY
+    on the TPU backend (CPU test equivalence must not silently change when
+    a TPU bench has run on the same checkout), and a missing/invalid file
+    (e.g. an installed wheel with no tools/ dir) means `fallback`."""
+    env = os.environ.get(knob)
+    if env is not None:
+        return env
+    if jax.default_backend() != "tpu":
+        return fallback
+    global _MEASURED_DEFAULTS
+    if _MEASURED_DEFAULTS is None:
+        import json
+        path = os.environ.get(
+            "DET_MEASURED_DEFAULTS_PATH",
+            os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "tools",
+                "measured_defaults.json"))
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            _MEASURED_DEFAULTS = {
+                k: (v.get("value") if isinstance(v, dict) else v)
+                for k, v in raw.items()}
+        except Exception:  # noqa: BLE001 - absent/invalid file = no flips
+            _MEASURED_DEFAULTS = {}
+    return _MEASURED_DEFAULTS.get(knob, fallback)
+
+
 def _dedup_impl() -> str:
     """'sort' (default): segment_sum aggregation — EXACT, and rep comes out
     strictly increasing so downstream ops promise unique+sorted.
@@ -56,7 +93,7 @@ def _dedup_impl() -> str:
     rep promise to unique-only (totals stay at segment-END rows, so OOB
     fillers interleave). Opt-in until tools/tpu_scatter_probe.py data
     lands."""
-    return os.environ.get("DET_DEDUP_IMPL", "sort")
+    return measured_default("DET_DEDUP_IMPL", "sort")
 
 
 def dedup_flags() -> dict:
@@ -95,7 +132,7 @@ class _KernelGate:
         return ok
 
     def active(self, ref_array) -> bool:
-        if (os.environ.get("DET_SCATTER_IMPL", "xla") != self.env_value
+        if (measured_default("DET_SCATTER_IMPL", "xla") != self.env_value
                 or jax.default_backend() != "tpu"):
             return False
         if isinstance(ref_array, jax.core.Tracer):
@@ -237,11 +274,11 @@ def prevalidate_active_impl(strategy: Optional[str] = None) -> None:
     dispatch to it. Call once before jitting a train step; no-op for the
     XLA default. Wired into make_sparse_train_step, so user code need not
     call it."""
-    impl = os.environ.get("DET_SCATTER_IMPL", "xla")
+    impl = measured_default("DET_SCATTER_IMPL", "xla")
     if jax.default_backend() != "tpu":
         return
     if (impl == "tiled" or strategy == "tiled"
-            or os.environ.get("DET_LOOKUP_PATH") == "tiled"):
+            or measured_default("DET_LOOKUP_PATH", "auto") == "tiled"):
         _TILED_GATE.prevalidate()
     if impl == "pallas":
         _PALLAS_GATE.prevalidate()

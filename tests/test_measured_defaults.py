@@ -1,0 +1,130 @@
+"""measured_default: bench-written hardware defaults for DET_* knobs.
+
+bench.py persists winning A/B knob values to tools/measured_defaults.json
+(decision rule 5, docs/perf_model.md); the dispatch reads them as the
+TPU-backend default. Env always overrides; CPU backends never consult the
+file (test equivalence must not change because a TPU bench ran)."""
+
+import json
+
+import jax
+import pytest
+
+from distributed_embeddings_tpu.ops import sparse_update
+
+
+@pytest.fixture
+def defaults_file(tmp_path, monkeypatch):
+    path = tmp_path / "measured_defaults.json"
+    path.write_text(json.dumps({
+        "DET_SCATTER_IMPL": {"value": "tiled", "git_sha": "abc",
+                             "measured_at": "2026-07-31T00:00:00Z"},
+        "DET_DEDUP_IMPL": "cumsum",          # bare-string form accepted
+    }))
+    monkeypatch.setenv("DET_MEASURED_DEFAULTS_PATH", str(path))
+    monkeypatch.setattr(sparse_update, "_MEASURED_DEFAULTS", None)
+    yield path
+    monkeypatch.setattr(sparse_update, "_MEASURED_DEFAULTS", None)
+
+
+def test_env_overrides_file(defaults_file, monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("DET_SCATTER_IMPL", "xla")
+    assert sparse_update.measured_default("DET_SCATTER_IMPL", "xla") == "xla"
+
+
+def test_file_used_on_tpu_backend(defaults_file, monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("DET_SCATTER_IMPL", raising=False)
+    assert sparse_update.measured_default("DET_SCATTER_IMPL",
+                                          "xla") == "tiled"
+    assert sparse_update.measured_default("DET_DEDUP_IMPL",
+                                          "sort") == "cumsum"
+    # unknown knob falls back
+    assert sparse_update.measured_default("DET_LOOKUP_PATH",
+                                          "auto") == "auto"
+
+
+def test_cpu_backend_ignores_file(defaults_file, monkeypatch):
+    monkeypatch.delenv("DET_SCATTER_IMPL", raising=False)
+    assert jax.default_backend() == "cpu"
+    assert sparse_update.measured_default("DET_SCATTER_IMPL", "xla") == "xla"
+
+
+def test_missing_file_falls_back(tmp_path, monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("DET_MEASURED_DEFAULTS_PATH",
+                       str(tmp_path / "nope.json"))
+    monkeypatch.setattr(sparse_update, "_MEASURED_DEFAULTS", None)
+    monkeypatch.delenv("DET_SCATTER_IMPL", raising=False)
+    assert sparse_update.measured_default("DET_SCATTER_IMPL", "xla") == "xla"
+    monkeypatch.setattr(sparse_update, "_MEASURED_DEFAULTS", None)
+
+
+def _load_bench():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "det_bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+def test_bench_writer_round_trip(tmp_path, monkeypatch):
+    """bench._maybe_write_measured_defaults with agreeing winners on BOTH
+    workloads writes the file the library reads back; anything less flips
+    nothing."""
+    bench = _load_bench()
+    out = tmp_path / "measured_defaults.json"
+    monkeypatch.setattr(bench, "_MEASURED_DEFAULTS_PATH", str(out))
+
+    class _FakeDev:
+        platform = "tpu"
+
+    monkeypatch.setattr(bench.jax, "devices", lambda: [_FakeDev()])
+    record = {"tiny_best_path": "tiled-fwd+bwd",
+              "dlrm_best_path": "tiled-fwd+bwd",
+              "git_sha": "deadbeef", "value": 90.0,
+              "dlrm_samples_per_sec": 2.6e6}
+    bench._maybe_write_measured_defaults(record)
+    assert record["measured_defaults_written"] == {
+        "DET_SCATTER_IMPL": "tiled", "DET_LOOKUP_PATH": "tiled"}
+    data = json.loads(out.read_text())
+    assert data["DET_SCATTER_IMPL"]["value"] == "tiled"
+    assert data["DET_LOOKUP_PATH"]["value"] == "tiled"
+    assert data["DET_SCATTER_IMPL"]["git_sha"] == "deadbeef"
+
+    # disagreeing winners flip nothing
+    record2 = {"tiny_best_path": "default(xla)",
+               "dlrm_best_path": "tiled-onehot-matmul", "git_sha": "x"}
+    bench._maybe_write_measured_defaults(record2)
+    assert "measured_defaults_written" not in record2
+
+    # a MISSING workload (dlrm errored) must not weaken the rule to
+    # single-workload agreement
+    record3 = {"tiny_best_path": "tiled-onehot-matmul", "git_sha": "x"}
+    bench._maybe_write_measured_defaults(record3)
+    assert "measured_defaults_written" not in record3
+
+    # cumsum wall-clock wins never auto-flip numerics defaults
+    record4 = {"tiny_best_path": "xla+cumsum-dedup",
+               "dlrm_best_path": "cumsum", "git_sha": "x"}
+    bench._maybe_write_measured_defaults(record4)
+    assert "measured_defaults_written" not in record4
+
+
+def test_bench_isolation_pins_reader(monkeypatch):
+    """_isolate_from_measured_defaults points the in-process reader at an
+    unparsable path and drops the cache, so the bench's baseline arms can
+    never be contaminated by an earlier flip."""
+    import os
+    bench = _load_bench()
+    monkeypatch.setenv("DET_MEASURED_DEFAULTS_PATH", "/tmp/whatever.json")
+    bench._isolate_from_measured_defaults()
+    assert os.environ["DET_MEASURED_DEFAULTS_PATH"] == os.devnull
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("DET_SCATTER_IMPL", raising=False)
+    assert sparse_update.measured_default("DET_SCATTER_IMPL", "xla") == "xla"
+    monkeypatch.setattr(sparse_update, "_MEASURED_DEFAULTS", None)

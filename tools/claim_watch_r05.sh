@@ -59,9 +59,11 @@ print('fetch', float(jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128)))))
     done < "$STAGES"
     rm -f "$BUSY"
     git add -- tools/watch_*_r05.out tools/bench_last_tpu.json \
+        tools/measured_defaults.json \
         tools/claim_watch_r05.log 2>/dev/null || true
     git commit -q -m "Hardware window artifacts (r05 claim watcher)" \
         -- tools/watch_*_r05.out tools/bench_last_tpu.json \
+        tools/measured_defaults.json \
         tools/claim_watch_r05.log 2>/dev/null || true
     if [ "$bench_rc" -eq 0 ] \
        && grep -q '"metric"' tools/watch_bench_r05.out \
