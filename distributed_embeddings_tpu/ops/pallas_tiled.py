@@ -322,15 +322,30 @@ def _shrink(vocab: int, n: int, chunk: int, tile: int):
     return chunk, tile
 
 
+def _sorted_stream(ids, contribs, vocab: int, presorted):
+    """(sid, permuted contrib rows) for an update kernel: fresh sort, or a
+    caller-provided (sid, perm) — e.g. the forward lookup's sort reused by
+    the backward over the SAME id stream (saves ~2 ns/key sort + the key
+    build; XLA CSE does not merge the fwd/bwd sorts on its own, measured
+    round 5 — see docs/perf_model.md 'Sort folding')."""
+    if presorted is None:
+        return _sort_ids(ids, contribs, vocab)[:2]
+    sid, perm = presorted
+    rows = None if contribs is None else jnp.take(contribs, perm, axis=0)
+    return sid, rows
+
+
 def tiled_sgd(table: jax.Array, ids: jax.Array, contribs: jax.Array, lr,
               chunk: int = _CHUNK, tile: int = _TILE,
-              interpret: Optional[bool] = None) -> jax.Array:
+              interpret: Optional[bool] = None,
+              presorted=None) -> jax.Array:
     """table[ids] -= lr * contribs with duplicate aggregation in-kernel.
-    Invalid ids dropped. lr may be traced (SMEM scalar)."""
+    Invalid ids dropped. lr may be traced (SMEM scalar). `presorted` may
+    carry this id stream's (sid, perm) from a prior `_sort_ids`."""
     if ids.shape[0] == 0:
         return table
     chunk, tile = _shrink(table.shape[0], ids.shape[0], chunk, tile)
-    sid, rows, _ = _sort_ids(ids, contribs, table.shape[0])
+    sid, rows = _sorted_stream(ids, contribs, table.shape[0], presorted)
     hp = jnp.asarray(lr, jnp.float32).reshape(1, 1)
     return _update_call(_sgd_kernel, 1, table, [], sid, rows, hp,
                         chunk, tile, interpret)
@@ -339,7 +354,7 @@ def tiled_sgd(table: jax.Array, ids: jax.Array, contribs: jax.Array, lr,
 def tiled_adagrad(table: jax.Array, accum: jax.Array, ids: jax.Array,
                   contribs: jax.Array, lr, eps: float = 1e-10,
                   chunk: int = _CHUNK, tile: int = _TILE,
-                  interpret: Optional[bool] = None):
+                  interpret: Optional[bool] = None, presorted=None):
     """Fused row-wise adagrad with in-kernel duplicate aggregation:
         total[r]  = sum of contribs rows for r
         acc[r]   += total^2 ; table[r] -= lr * total * rsqrt(acc[r] + eps)
@@ -348,7 +363,7 @@ def tiled_adagrad(table: jax.Array, accum: jax.Array, ids: jax.Array,
     if ids.shape[0] == 0:
         return table, accum
     chunk, tile = _shrink(table.shape[0], ids.shape[0], chunk, tile)
-    sid, rows, _ = _sort_ids(ids, contribs, table.shape[0])
+    sid, rows = _sorted_stream(ids, contribs, table.shape[0], presorted)
     hp = jnp.asarray(lr, jnp.float32).reshape(1, 1)
     out = _update_call(functools.partial(_adagrad_kernel, eps=eps), 2,
                        table, [accum], sid, rows, hp, chunk, tile, interpret)
@@ -407,7 +422,8 @@ def _adam_kernel(tof_ref, cof_ref, ids_ref, grads_ref, hp_ref, table_ref,
 def tiled_adam(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
                ids: jax.Array, contribs: jax.Array, lr, b1: float = 0.9,
                b2: float = 0.999, eps: float = 1e-8, chunk: int = _CHUNK,
-               tile: int = _TILE, interpret: Optional[bool] = None):
+               tile: int = _TILE, interpret: Optional[bool] = None,
+               presorted=None):
     """Fused lazy row-wise adam with in-kernel duplicate aggregation;
     matches sparse_update.sparse_adam (touched rows decay, bias correction
     by global step count) to f32 tolerance. Returns (table, mu, nu, count);
@@ -420,7 +436,7 @@ def tiled_adam(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
     c1 = 1.0 - lax.pow(jnp.float32(b1), cf)
     c2 = 1.0 - lax.pow(jnp.float32(b2), cf)
     chunk, tile = _shrink(table.shape[0], ids.shape[0], chunk, tile)
-    sid, rows, _ = _sort_ids(ids, contribs, table.shape[0])
+    sid, rows = _sorted_stream(ids, contribs, table.shape[0], presorted)
     hp = jnp.stack([jnp.asarray(lr, jnp.float32).reshape(()), c1,
                     c2]).reshape(1, 3)
     out = _update_call(
@@ -511,18 +527,27 @@ def tiled_gather_sorted(table: jax.Array, sid: jax.Array,
 
 def tiled_gather(table: jax.Array, ids: jax.Array,
                  chunk: int = _CHUNK, tile: int = _TILE,
-                 interpret: Optional[bool] = None) -> jax.Array:
+                 interpret: Optional[bool] = None,
+                 presorted=None) -> jax.Array:
     """rows[k] = table[ids[k]] for arbitrary-order ids (invalid ids yield
-    zero rows): sort + tiled sorted gather + inverse permute."""
+    zero rows): sort + tiled sorted gather + inverse permute. `presorted`
+    reuses a prior (sid, perm) of this id stream."""
     if ids.shape[0] == 0:
         return jnp.zeros((0, table.shape[1]), jnp.float32)
-    sid, _, perm = _sort_ids(ids, None, table.shape[0])
+    inv = None
+    if presorted is None:
+        sid, _, perm = _sort_ids(ids, None, table.shape[0])
+    elif len(presorted) == 3:          # (sid, perm, inv): fully precomputed
+        sid, perm, inv = presorted
+    else:
+        sid, perm = presorted
     rows = tiled_gather_sorted(table, sid, chunk, tile, interpret)
-    # SCATTER-FREE inverse permutation (second sort + take): an
-    # .at[perm].set would reintroduce the ~100 ns/row scatter lowering
-    # this whole path exists to avoid (round-3 prims)
-    iota = lax.iota(jnp.int32, perm.shape[0])
-    inv = lax.sort_key_val(perm, iota)[1]
+    if inv is None:
+        # SCATTER-FREE inverse permutation (second sort + take): an
+        # .at[perm].set would reintroduce the ~100 ns/row scatter lowering
+        # this whole path exists to avoid (round-3 prims)
+        iota = lax.iota(jnp.int32, perm.shape[0])
+        inv = lax.sort_key_val(perm, iota)[1]
     return jnp.take(rows, inv, axis=0)
 
 
@@ -530,10 +555,10 @@ def tiled_gather(table: jax.Array, ids: jax.Array,
 # forward lookup-combine on the tiled gather (drop-in for the XLA
 # gather+reduce in DistributedEmbedding._group_lookup)
 # --------------------------------------------------------------------------
-def _tiled_lookup_impl(params, ids, weights, interpret):
+def _tiled_lookup_impl(params, ids, weights, interpret, presorted=None):
     b, k = ids.shape
-    rows = tiled_gather(params, ids.reshape(-1),
-                        interpret=interpret).reshape(b, k, -1)
+    rows = tiled_gather(params, ids.reshape(-1), interpret=interpret,
+                        presorted=presorted).reshape(b, k, -1)
     return jnp.einsum("bk,bkw->bw", weights.astype(jnp.float32), rows)
 
 
@@ -543,22 +568,35 @@ def _tiled_lookup(params, ids, weights, interpret):
 
 
 def _tiled_lookup_fwd(params, ids, weights, interpret):
-    return (_tiled_lookup_impl(params, ids, weights, interpret),
-            (params, ids, weights))
+    # sort once: the backward reuses (sid, perm, inv) for BOTH its
+    # aggregation and its dweights gather (the id stream is identical, and
+    # XLA CSE does not merge fwd/bwd sorts — measured round 5)
+    sid, _, perm = _sort_ids(ids.reshape(-1), None, params.shape[0])
+    iota = lax.iota(jnp.int32, perm.shape[0])
+    inv = lax.sort_key_val(perm, iota)[1]
+    return (_tiled_lookup_impl(params, ids, weights, interpret,
+                               presorted=(sid, perm, inv)),
+            (params, ids, weights, sid, perm, inv))
 
 
 def _tiled_lookup_bwd(interpret, res, g):
-    # dense-table scatter-add backward, identical to the XLA formulation
-    # (pallas_lookup._fused_bwd) — only the DENSE train path differentiates
-    # through the lookup; the sparse tapped path extracts gradients at the
-    # taps and applies them via the tiled update kernels instead
-    params, ids, weights = res
+    # Dense-table cotangent WITHOUT a scatter (ADVICE r4: the previous
+    # zeros.at[ids].add here was the exact ~100 ns/row lowering this module
+    # exists to avoid): aggregate duplicate rows on the MXU via the sgd
+    # kernel at lr = -1 over a zero table, reusing the forward's sort.
+    # Only the DENSE train path differentiates through the lookup; the
+    # sparse tapped path extracts gradients at the taps and applies them
+    # via the tiled update kernels directly.
+    params, ids, weights, sid, perm, inv = res
     flat_ids = ids.reshape(-1)
-    contrib = (weights[..., None].astype(g.dtype) * g[:, None, :]).reshape(
-        -1, g.shape[-1])
-    dtable = jnp.zeros_like(params).at[flat_ids].add(
-        contrib.astype(params.dtype))
-    rows = jnp.take(params, ids, axis=0).astype(g.dtype)
+    contrib = (weights[..., None].astype(jnp.float32)
+               * g[:, None, :].astype(jnp.float32)).reshape(-1, g.shape[-1])
+    dtable = tiled_sgd(jnp.zeros(params.shape, jnp.float32), flat_ids,
+                       contrib, -1.0, interpret=interpret,
+                       presorted=(sid, perm)).astype(params.dtype)
+    rows = tiled_gather(params, flat_ids, interpret=interpret,
+                        presorted=(sid, perm, inv)).reshape(
+        ids.shape[0], ids.shape[1], -1).astype(g.dtype)
     dweights = jnp.einsum("bkw,bw->bk", rows, g).astype(weights.dtype)
     return dtable, None, dweights
 

@@ -162,8 +162,9 @@ def test_ragged_exchange_auto_policy(monkeypatch):
                         input_max_hotness=[4, 4])
 
     grp_pad = types.SimpleNamespace(rank_slots=[[0], [], [], [], [], [], [],
-                                                []], k=4, f_max=1)
-    grp_tight = types.SimpleNamespace(rank_slots=[[0]] * 8, k=4, f_max=1)
+                                                []], k=4, f_max=1, bucket=0)
+    grp_tight = types.SimpleNamespace(rank_slots=[[0]] * 8, k=4, f_max=1,
+                                      bucket=1)
     monkeypatch.delenv("DET_RAGGED_EXCHANGE", raising=False)
     # CPU backend: auto never takes the ragged path
     assert not dist._use_ragged_exchange(grp_pad, 8)

@@ -235,6 +235,68 @@ def test_tiled_embedding_lookup_matches_fused_contract():
         np.testing.assert_allclose(gt_w, gr_w, rtol=1e-4, atol=1e-5)
 
 
+def test_tiled_lookup_dense_grad_scatter_free():
+    """Round 5 (ADVICE r4): differentiating the tiled lookup on the DENSE
+    path must not materialize a zeros.at[ids].add table-gradient scatter —
+    the backward aggregates via the sgd kernel reusing the forward's
+    sort, so grad-of-lookup lowers with zero stablehlo.scatter ops."""
+    import re
+    from distributed_embeddings_tpu.ops import pallas_tiled as pt2
+
+    v, w, b, k = 4096, 16, 32, 4
+    table = jax.ShapeDtypeStruct((v, w), jnp.float32)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, v, (b, k))
+                      .astype(np.int32))
+
+    def loss(t):
+        return jnp.sum(pt2.tiled_embedding_lookup(t, ids, None, "sum",
+                                                  interpret=True))
+
+    txt = jax.jit(jax.grad(loss)).lower(table).as_text()
+    scatters = re.findall(r'"stablehlo\.scatter"', txt)
+    assert not scatters, f"{len(scatters)} scatter ops in tiled-lookup grad"
+
+
+def test_presorted_matches_fresh_sort():
+    """tiled_sgd/adagrad/adam/gather with a caller-provided (sid, perm)
+    must equal the fresh-sort path bit for bit."""
+    from distributed_embeddings_tpu.ops import pallas_tiled as pt2
+
+    rng = np.random.RandomState(9)
+    v, w, n = 600, 16, 256
+    ids = jnp.asarray(rng.randint(-5, v + 5, n).astype(np.int32))
+    contribs = jnp.asarray(rng.randn(n, w).astype(np.float32))
+    table = jnp.asarray(rng.randn(v, w).astype(np.float32))
+    acc = jnp.abs(jnp.asarray(rng.randn(v, w).astype(np.float32))) + 0.1
+    pre = pt2._sort_ids(ids, None, v)
+    presorted = (pre[0], pre[2])
+
+    a = pt2.tiled_sgd(table, ids, contribs, 0.05, interpret=True)
+    b = pt2.tiled_sgd(table, ids, contribs, 0.05, interpret=True,
+                      presorted=presorted)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    a = pt2.tiled_adagrad(table, acc, ids, contribs, 0.05, interpret=True)
+    b = pt2.tiled_adagrad(table, acc, ids, contribs, 0.05, interpret=True,
+                          presorted=presorted)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    mu = jnp.zeros((v, w), jnp.float32)
+    nu = jnp.zeros((v, w), jnp.float32)
+    cnt = jnp.zeros((), jnp.int32)
+    a = pt2.tiled_adam(table, mu, nu, cnt, ids, contribs, 0.01,
+                       interpret=True)
+    b = pt2.tiled_adam(table, mu, nu, cnt, ids, contribs, 0.01,
+                       interpret=True, presorted=presorted)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    a = pt2.tiled_gather(table, ids, interpret=True)
+    b = pt2.tiled_gather(table, ids, interpret=True, presorted=presorted)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_tiled_lookup_path_forward_equivalence(monkeypatch):
     """DET_LOOKUP_PATH=tiled through DistributedEmbedding matches the
     default XLA forward on the 8-CPU mesh (interpret mode)."""
