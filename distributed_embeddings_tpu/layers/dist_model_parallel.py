@@ -756,23 +756,13 @@ class DistributedEmbedding:
                 table, ids.reshape(b_sz * f, k), w.reshape(b_sz * f, k),
                 combiner)
             return self._cast(out.reshape(b_sz, f, out.shape[-1]))
-        # DET_SORTED_GATHER=1: sort ids once, gather with HBM locality,
-        # inverse-permute scatter-free (argsort + take). Round-3 prims:
-        # the raw 25M-row gather runs ~22 ns/row while a same-size permute
-        # runs ~4 ns/row and sort ~2 ns/key — if the sorted gather lands
-        # anywhere near permute rate the composite wins (probe-gated:
-        # tools/tpu_scatter_probe.py 'sort+sortedgather+unperm').
-        sg = os.environ.get("DET_SORTED_GATHER", "0")
-        if sg == "force" or (sg == "1" and pallas_lookup.is_tpu_backend()):
-            flat = ids.reshape(-1).astype(jnp.int32)
-            iota = lax.iota(jnp.int32, flat.shape[0])
-            sid, perm = lax.sort_key_val(flat, iota)
-            inv = lax.sort_key_val(perm, iota)[1]
-            rows = jnp.take(table, sid, axis=0, indices_are_sorted=True)
-            emb = self._cast(jnp.take(rows, inv, axis=0).reshape(
-                b_sz, f, k, table.shape[-1]))
-        else:
-            emb = self._cast(jnp.take(table, ids, axis=0))  # [B, f, k, w]
+        # (The round-3 DET_SORTED_GATHER sort+sorted-gather+unpermute
+        # variant was removed in round 5: DET_LOOKUP_PATH=tiled IS that
+        # composite done properly — sort + block-streamed tiled gather +
+        # scatter-free unpermute — and the knob never earned its own
+        # hardware number. The 'sort+sortedgather+unperm' prim composite in
+        # tools/tpu_scatter_probe.py still measures the hypothesis.)
+        emb = self._cast(jnp.take(table, ids, axis=0))      # [B, f, k, w]
         return _combine(emb, weights, combiner)
 
     def _cast(self, x: jax.Array) -> jax.Array:

@@ -450,20 +450,14 @@ def _pick(strategy: str, rows: int, width: int) -> str:
 def sparse_sgd(table: jax.Array, grad: SparseRowGrad, lr,
                strategy: str = "auto") -> jax.Array:
     """table[ids] -= lr * contribs. Duplicates need no aggregation (add is
-    associative); OOB/padded ids are dropped by the scatter.
-
-    DET_SGD_DEDUP=1 aggregates first: the raw-duplicate scatter can make
-    no promises to XLA (round-3 prims: 106 ns/row duplicate-safe lowering)
-    while the deduped scatter is unique(+sorted) and Pallas-eligible —
-    whether sort+aggregate+promised-scatter beats one raw scatter is a
-    hardware question, hence opt-in."""
+    associative); OOB/padded ids are dropped by the scatter. (The round-3
+    DET_SGD_DEDUP aggregate-first variant was removed in round 5: the
+    tiled kernel family subsumes its hypothesis — aggregation happens
+    in-kernel with no scatter at all — and the knob never earned a
+    hardware number; docs/round5_notes.md 'knob disposition'.)"""
     if _tiled_route(strategy, table):
         from distributed_embeddings_tpu.ops import pallas_tiled as ptl
         return ptl.tiled_sgd(table, grad.ids, grad.contribs, lr)
-    if os.environ.get("DET_SGD_DEDUP", "0") == "1":
-        rep, sums = dedup_sum(grad.ids, grad.contribs,
-                              sentinel=table.shape[0])
-        return _row_scatter_add(table, rep, -lr * sums)
     # negative ids -> dropped OOB row, not NumPy wraparound (see dedup_sum)
     safe_ids = jnp.where(grad.ids < 0, table.shape[0], grad.ids)
     return table.at[safe_ids].add(

@@ -281,24 +281,3 @@ def test_ragged_exchange_native_lowering(monkeypatch):
     assert "ragged_all_to_all" in txt, txt[:2000]
 
 
-def test_sorted_gather_forward_exact(monkeypatch):
-    """DET_SORTED_GATHER: sort + locality gather + scatter-free inverse
-    permute must reproduce the plain gather bit-exactly (it moves the same
-    rows). 'force' enables the path off-TPU for this test."""
-    from distributed_embeddings_tpu.layers.dist_model_parallel import (
-        DistributedEmbedding)
-    from distributed_embeddings_tpu.layers.embedding import Embedding
-
-    rng = np.random.RandomState(3)
-    specs = [(500, 8), (300, 16)]
-    dist = DistributedEmbedding(
-        [Embedding(v, w, combiner="sum") for v, w in specs], mesh=None)
-    weights = [rng.randn(v, w).astype(np.float32) for v, w in specs]
-    params = dist.set_weights(weights)
-    ins = [jnp.asarray(rng.randint(0, v, (16, 3)).astype(np.int32))
-           for v, _ in specs]
-    base = dist.apply(params, ins)
-    monkeypatch.setenv("DET_SORTED_GATHER", "force")
-    got = dist.apply(params, ins)
-    for a, b in zip(base, got):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -219,21 +219,6 @@ def test_scatter_impl_pallas_ignored_off_tpu(monkeypatch):
     np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
 
 
-@pytest.mark.parametrize("oob", [False, True])
-def test_sparse_sgd_dedup_opt_in_matches(monkeypatch, oob):
-    """DET_SGD_DEDUP=1 (aggregate-then-promised-scatter) == the raw
-    duplicate scatter, to f32 reassociation tolerance."""
-    rng = np.random.default_rng(11)
-    ids, contribs, dense = make_case(rng, n=513, oob=oob)
-    table = rng.standard_normal((50, 8)).astype(np.float32)
-    g = su.SparseRowGrad(jnp.asarray(ids), jnp.asarray(contribs))
-    want = su.sparse_sgd(jnp.asarray(table), g, 0.1)
-    monkeypatch.setenv("DET_SGD_DEDUP", "1")
-    got = su.sparse_sgd(jnp.asarray(table), g, 0.1)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               rtol=1e-5, atol=1e-5)
-
-
 def test_sparse_adagrad_traced_lr(monkeypatch):
     """lr as a traced value (schedule through jit args) must work on every
     path — the Pallas fused kernel needs static lr, so the dispatch falls

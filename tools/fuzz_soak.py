@@ -1,7 +1,8 @@
 """Extended fuzz soak: higher seeds than the suite's fixed range, with the
-round-3 dispatch knobs randomized per case (DET_DEDUP_IMPL, DET_SGD_DEDUP,
-DET_SORTED_GATHER=force) so knob interactions get coverage the named tests
-don't. Exact equivalence bar is the same as tests/test_fuzz_equivalence.
+dispatch knobs randomized per case (DET_DEDUP_IMPL; DET_SGD_DEDUP and
+DET_SORTED_GATHER were retired in round 5) so knob interactions get
+coverage the named tests don't. Exact equivalence bar is the same as
+tests/test_fuzz_equivalence.
 
 Usage: python tools/fuzz_soak.py [first_seed] [n_seeds]
 """
@@ -36,10 +37,6 @@ def main():
         knobs = {}
         if rng.rand() < 0.4:
             knobs["DET_DEDUP_IMPL"] = "cumsum"
-        if rng.rand() < 0.3:
-            knobs["DET_SGD_DEDUP"] = "1"
-        if rng.rand() < 0.3:
-            knobs["DET_SORTED_GATHER"] = "force"
         specs, table_map, kw = gen_config(seed)
         # cumsum dedup is tolerance-equal, not exact
         if knobs.get("DET_DEDUP_IMPL") == "cumsum":
