@@ -31,6 +31,12 @@ from distributed_embeddings_tpu.training import (
     DistributedGradientTape,
     DistributedOptimizer,
 )
+from distributed_embeddings_tpu import serving
+from distributed_embeddings_tpu.serving import (
+    HotRowCache,
+    InferenceEngine,
+    MicroBatcher,
+)
 
 __all__ = [
     "__version__",
@@ -47,4 +53,8 @@ __all__ = [
     "DistributedGradientTape",
     "DistributedOptimizer",
     "BroadcastGlobalVariablesCallback",
+    "serving",
+    "InferenceEngine",
+    "HotRowCache",
+    "MicroBatcher",
 ]

@@ -1,0 +1,64 @@
+"""Version/backend compatibility shims.
+
+The library targets the moving jax API surface across the versions its
+deployment environments actually carry. Two seams matter:
+
+  * ``shard_map`` moved: old releases expose it as
+    ``jax.experimental.shard_map.shard_map`` with a ``check_rep`` flag; new
+    ones as ``jax.shard_map`` with ``check_vma``. ``shard_map`` here accepts
+    the new-style signature and lowers to whichever the installed jax has.
+  * Host memory spaces are backend-dependent: TPU backends expose
+    ``pinned_host`` next to ``device``; the XLA:CPU backend of older
+    releases exposes only ``unpinned_host`` (which is also its *default*
+    space — host "offload" is then a placement no-op, but the whole
+    offload/serving code path, including ``compute_on`` host regions, still
+    compiles and runs, which is what the CPU test mesh needs).
+    ``host_memory_kind`` picks the best available host space.
+"""
+
+from typing import Optional
+
+import jax
+
+__all__ = ["shard_map", "host_memory_kind", "default_memory_kind"]
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """New-style ``jax.shard_map`` signature on any supported jax."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)
+
+
+def _memory_kinds(device) -> set:
+    try:
+        return {m.kind for m in device.addressable_memories()}
+    except Exception:  # noqa: BLE001 - backend without the memories API
+        return set()
+
+
+def host_memory_kind(device) -> Optional[str]:
+    """The backend's host memory space for table offload: ``pinned_host``
+    where the runtime supports it (TPU; DMA-able), else ``unpinned_host``
+    (older XLA:CPU), else None (no host space — offload must stay off)."""
+    kinds = _memory_kinds(device)
+    for kind in ("pinned_host", "unpinned_host"):
+        if kind in kinds:
+            return kind
+    return None
+
+
+def default_memory_kind(device) -> Optional[str]:
+    """The memory space a plain array lands in on `device` ('device' on
+    TPU/GPU; older XLA:CPU reports 'unpinned_host'). Lets tests assert
+    offload placement without hardcoding a backend's space names."""
+    try:
+        return device.default_memory().kind
+    except Exception:  # noqa: BLE001
+        kinds = _memory_kinds(device)
+        if "device" in kinds:
+            return "device"
+        return next(iter(kinds), None)

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_embeddings_tpu import compat
 from distributed_embeddings_tpu.ops import embedding_ops, pallas_lookup
 from distributed_embeddings_tpu.ops import sparse_update as sparse_update_ops
 from distributed_embeddings_tpu.ops.embedding_ops import RaggedIds, SparseIds
@@ -400,6 +401,10 @@ class DistributedEmbedding:
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype is not None else None)
         self._groups_cache: dict = {}
+        # serving hook (see offload_lookup_scope): replaces the host-side
+        # offloaded-bucket lookup in tapless forwards — the HBM hot-row
+        # cache in `serving/` plugs in here
+        self._offload_lookup_override = None
         # (bucket, f_max, k) -> "ragged"|"padded": the exchange path each
         # group actually took (filled at trace time, see _use_ragged_exchange)
         self._exchange_path_taken: dict = {}
@@ -409,19 +414,20 @@ class DistributedEmbedding:
         # :829-831); their lookups run in a compute_on("device_host") region
         # outside the shard_map, streaming only combined rows device-ward.
         self._offload_enabled = False
+        self._host_kind = None
         if any(b.offload for b in self.plan.tp_buckets):
             devs = (list(self.mesh.devices.flat) if self.mesh is not None
                     else jax.devices())
-            try:
-                kinds = {m.kind for m in devs[0].addressable_memories()}
-            except Exception:  # noqa: BLE001 - backend without memories API
-                kinds = set()
-            self._offload_enabled = "pinned_host" in kinds
+            # pinned_host on TPU; older XLA:CPU only has unpinned_host (its
+            # default space — placement is then a no-op but the whole
+            # offload path still runs, which the CPU test mesh relies on)
+            self._host_kind = compat.host_memory_kind(devs[0])
+            self._offload_enabled = self._host_kind is not None
             if not self._offload_enabled:
                 import warnings
                 warnings.warn(
                     "gpu_embedding_size flagged table(s) for host offload, "
-                    "but this backend exposes no pinned_host memory space: "
+                    "but this backend exposes no host memory space: "
                     "offloaded buckets remain device-resident and count "
                     "against device memory.", RuntimeWarning, stacklevel=2)
 
@@ -458,9 +464,10 @@ class DistributedEmbedding:
                 if d.process_index == jax.process_index()]
 
     def _bucket_memory_kind(self, b: int) -> Optional[str]:
-        """'pinned_host' for physically-offloaded buckets, else None."""
+        """The backend's host memory kind (pinned_host on TPU) for
+        physically-offloaded buckets, else None."""
         if self._offload_enabled and self.plan.tp_buckets[b].offload:
-            return "pinned_host"
+            return self._host_kind
         return None
 
     def _param_sharding(self, memory_kind: Optional[str] = None):
@@ -838,7 +845,10 @@ class DistributedEmbedding:
                         f"rank-{out.ndim} output, expected rank "
                         f"{want_rank} ([batch, width] with a combiner, "
                         "[batch, hotness, width] without)")
-                dp_outs.append(out)
+                # custom outputs honor the compute_dtype policy like stock
+                # tables (ADVICE r5): without the cast, a mixed-precision
+                # model would see f32 here and bf16 everywhere else
+                dp_outs.append(self._cast(out))
                 continue
             emb = self._cast(jnp.take(table, ids, axis=0))   # [B_l, k, w]
             dp_outs.append(_combine(emb, weights, cfg.get("combiner")))
@@ -1038,12 +1048,12 @@ class DistributedEmbedding:
             rows_max = max(bucket.rows_max, 1)
             if self.mesh is not None:
                 host_sh = lambda: NamedSharding(self.mesh, P(self.axis),
-                                                memory_kind="pinned_host")
+                                                memory_kind=self._host_kind)
                 dev_sh = NamedSharding(self.mesh, P(self.axis))
             else:
                 dev0 = jax.devices()[0]
                 host_sh = lambda: jax.sharding.SingleDeviceSharding(
-                    dev0, memory_kind="pinned_host")
+                    dev0, memory_kind=self._host_kind)
                 dev_sh = jax.sharding.SingleDeviceSharding(dev0)
 
             def run(table_h, ids_g, w_g, tap):
@@ -1078,6 +1088,39 @@ class DistributedEmbedding:
             fn = jax.jit(run)
             self._host_fn_cache[key] = fn
         return fn(table_h, ids_g, w_g, tap)
+
+    def offload_lookup_scope(self, lookup_fn):
+        """Scope an offloaded-bucket lookup override over forwards.
+
+        ``lookup_fn(g, grp, table, ids_g, w_g) -> out | None`` is consulted
+        for every offloaded exchange group of a TAPLESS forward (training
+        forwards with taps always take the host path — the tap gradient
+        contract depends on it). Returning None falls back to the stock
+        host-memory lookup. `ids_g`/`w_g` and the required output layout
+        are exactly `_host_group_exchange`'s. This is the seam the serving
+        subsystem's HBM hot-row cache uses (serving/cache.py); the scope is
+        re-entrant per layer instance, not thread-safe.
+        """
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            prev = self._offload_lookup_override
+            self._offload_lookup_override = lookup_fn
+            try:
+                yield self
+            finally:
+                self._offload_lookup_override = prev
+        return scope()
+
+    def _offload_group_out(self, g, grp, table, off_id, off_w, tap_g):
+        """One offloaded group's output: the serving override when scoped
+        (and tapless), else the host-memory gather+combine."""
+        if tap_g is None and self._offload_lookup_override is not None:
+            out = self._offload_lookup_override(g, grp, table, off_id, off_w)
+            if out is not None:
+                return out
+        return self._host_group_exchange(table, grp, off_id, off_w, tap_g, g)
 
     def _tp_bucket_exchange(self, out: jax.Array) -> jax.Array:
         """mp->dp movement of one bucket's outputs: [B, f, wf] ->
@@ -1240,7 +1283,7 @@ class DistributedEmbedding:
                  for g in group_w],
                 [P(self.axis)] * len(row_in),
                 [P(self.axis)] * len(row_in)) if want_res else None,)
-            dp_outs, ex_list, row_outs, off_ids, off_w, res = jax.shard_map(
+            dp_outs, ex_list, row_outs, off_ids, off_w, res = compat.shard_map(
                 lambda d, t, r, di, gi, gw, ri, tp: self._forward_local(
                     d, t, r, di, gi, gw, ri, groups, taps=tp,
                     want_res=want_res),
@@ -1255,12 +1298,13 @@ class DistributedEmbedding:
                     dp_in, group_ids, group_w, row_in, groups,
                     taps=inner_taps, want_res=want_res))
 
-        # offloaded buckets: host-side lookup + GSPMD exchange
+        # offloaded buckets: host-side lookup + GSPMD exchange (or the
+        # scoped serving override — see offload_lookup_scope)
         for g in offloaded_groups:
             grp = groups[g]
             tap_g = taps["tp"][g] if taps is not None else None
-            ex_list[g] = self._host_group_exchange(
-                params["tp"][grp.bucket], grp, off_ids[g], off_w[g], tap_g, g)
+            ex_list[g] = self._offload_group_out(
+                g, grp, params["tp"][grp.bucket], off_ids[g], off_w[g], tap_g)
 
         # ---- assemble per-input outputs ------------------------------------
         dp_final = []
@@ -1545,7 +1589,7 @@ class DistributedEmbedding:
                   [None if g is None else P(self.axis) for g in group_w])
                  if return_residuals else None),
             )
-            ex_list, off_ids, off_w, res = jax.shard_map(
+            ex_list, off_ids, off_w, res = compat.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(specs(params["tp"], P(self.axis)),
                           specs(group_ids, P(self.axis)),
@@ -1561,8 +1605,8 @@ class DistributedEmbedding:
         for g in offloaded_groups:
             grp = groups[g]
             tap_g = taps["tp"][g] if taps is not None else None
-            ex_list[g] = self._host_group_exchange(
-                params["tp"][grp.bucket], grp, off_ids[g], off_w[g], tap_g, g)
+            ex_list[g] = self._offload_group_out(
+                g, grp, params["tp"][grp.bucket], off_ids[g], off_w[g], tap_g)
 
         outputs = self._assemble_tp_outputs(ex_list, tp_preps, batch,
                                             groups, assembly)
@@ -1755,14 +1799,14 @@ class DistributedEmbedding:
                     fill = float(np.asarray(x)[0, 0])
                     if self.mesh is None:
                         host = jax.sharding.SingleDeviceSharding(
-                            jax.devices()[0], memory_kind="pinned_host")
+                            jax.devices()[0], memory_kind=self._host_kind)
                         out.append(jax.device_put(
                             np.full(stack.shape, fill, np.float32), host))
                     else:
                         out.append(self._stack_sharded(
                             lambda rank: np.full(stack.shape[1:], fill,
                                                  np.float32),
-                            memory_kind="pinned_host"))
+                            memory_kind=self._host_kind))
                 else:
                     out.append(x)
             return tuple(out)
@@ -1829,7 +1873,7 @@ class DistributedEmbedding:
             out_specs = (pspec(tp_dev, P(self.axis)),
                          pspec(params["row"], P(self.axis)),
                          sspec(tp_dev_s), sspec(opt_states["row"]))
-            new_tp_dev, new_row, new_tp_dev_s, new_row_s = jax.shard_map(
+            new_tp_dev, new_row, new_tp_dev_s, new_row_s = compat.shard_map(
                 lambda *a: self._sparse_update_body(*a, groups, opt,
                                                     dev_buckets),
                 mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
@@ -1900,12 +1944,12 @@ class DistributedEmbedding:
               if k in hp and opt.kind in ("adagrad", "adam")}
         if self.mesh is not None:
             host_sh = NamedSharding(self.mesh, P(self.axis),
-                                    memory_kind="pinned_host")
+                                    memory_kind=self._host_kind)
             dev_sh = NamedSharding(self.mesh, P(self.axis))
         else:
             dev0 = jax.devices()[0]
             host_sh = jax.sharding.SingleDeviceSharding(
-                dev0, memory_kind="pinned_host")
+                dev0, memory_kind=self._host_kind)
             dev_sh = jax.sharding.SingleDeviceSharding(dev0)
         # per-world-shard state leaves map over axis 0; global scalars
         # (adam's step count) are shared across shards and stay unmapped
@@ -2075,7 +2119,24 @@ class DistributedEmbedding:
             rep_np = np.asarray(rep_d[dev])     # rows only cross the wire
             sums_np = np.asarray(sums_d[dev])
             valid_np = np.asarray(valid_d[dev])
-            for j in range(t_np.shape[0]):      # world slices on this shard
+            # indexing below pairs world-slice j of the table shard with
+            # world-slice j of the pending arrays — valid ONLY while both
+            # carry the same P(axis) layout. If XLA ever materializes the
+            # pending arrays differently (e.g. replicated), silently
+            # applying the wrong slices would corrupt training (ADVICE r5).
+            nw = t_np.shape[0]
+            drift = [(name, a.shape) for name, a in
+                     (("rep", rep_np), ("sums", sums_np), ("valid", valid_np),
+                      *((f"state[{i}]", s) for i, s in enumerate(s_nps)))
+                     if a.shape[0] != nw]
+            if drift:
+                raise RuntimeError(
+                    f"offloaded per-shard apply: device {dev} holds "
+                    f"{nw} world slice(s) of the table but the update "
+                    f"arrays have mismatched leading dims {drift} — "
+                    "sharding layout drifted between the step jit's "
+                    "pending outputs and the pinned-host bucket")
+            for j in range(nw):                 # world slices on this shard
                 if kind == "adam":
                     st = (s_nps[0][j], s_nps[1][j],
                           next(iter(scalar_after.values())))
@@ -2182,8 +2243,10 @@ class DistributedEmbedding:
         out = np.empty(arr.shape, dtype=arr.dtype)
         for r0 in range(0, rows, chunk):
             r1 = min(rows, r0 + chunk)
-            piece = (_slice_rows_jit(arr, r0, r1) if host_kind
-                     else arr[:, r0:r1])
+            # jit-sliced for BOTH memory kinds: eager indexing of a
+            # non-fully-addressable device array is backend-dependent
+            # (ADVICE r5), while the cached jitted slice is always legal
+            piece = _slice_rows_jit(arr, r0, r1)
             out[:, r0:r1] = np.asarray(
                 multihost_utils.process_allgather(piece, tiled=True))
         return out

@@ -195,6 +195,43 @@ def embedding_lookup_weighted(
     return out
 
 
+def miss_only_ids(ids: jax.Array, slot_idx: jax.Array) -> jax.Array:
+    """Clamp cache-hit lanes' ids to row 0 for the miss-side table gather.
+
+    `slot_idx >= 0` marks lanes a row cache will serve from device memory;
+    the fallback gather must still have a static shape, so hit lanes read a
+    single dummy row (row 0) instead of their real row — the table sees no
+    read traffic proportional to hits. Shapes broadcast elementwise.
+    """
+    return jnp.where(slot_idx >= 0, jnp.zeros((), ids.dtype), ids)
+
+
+def masked_two_source_gather(slots: jax.Array, slot_idx: jax.Array,
+                             fallback_rows: jax.Array) -> jax.Array:
+    """Row-select between a cache tensor and pre-gathered fallback rows.
+
+    The serving hot-row cache's combining primitive
+    (serving/cache.py): lanes with ``slot_idx >= 0`` take row
+    ``slots[slot_idx]`` (an HBM gather); the rest take the matching row of
+    `fallback_rows` (typically gathered from a host-resident table with
+    `miss_only_ids`). Keeping the select separate from the two gathers lets
+    the caller place each gather in its own memory space.
+
+    Args:
+      slots: [capacity, width] cached rows.
+      slot_idx: [...] int32, -1 (or any negative) = miss.
+      fallback_rows: [..., width] rows for the miss lanes (hit lanes'
+        values are ignored).
+
+    Returns [..., width]: the merged rows.
+    """
+    hit = slot_idx >= 0
+    safe = jnp.clip(slot_idx, 0, slots.shape[0] - 1)
+    cached = jnp.take(slots, safe, axis=0)
+    return jnp.where(hit[..., None], cached.astype(fallback_rows.dtype),
+                     fallback_rows)
+
+
 def ragged_to_padded(
     ids: RaggedIds, max_hotness: int, combiner: str = "sum"
 ) -> Tuple[jax.Array, jax.Array]:

@@ -638,6 +638,20 @@ def host_apply_rows_inplace(kind: str, table, state, rep, sums, valid, lr,
             f"host_apply_rows_inplace is float32-only, got {bad}; use the "
             "roundtrip offload apply (DET_HOST_APPLY=roundtrip) for "
             "non-f32 buckets")
+    # the C++ kernels below consume raw .ctypes.data pointers with a dense
+    # row-major stride assumption: a non-contiguous view here is silent
+    # memory corruption, not an error (ADVICE r5) — refuse it up front for
+    # the numpy path too so both implementations reject the same inputs
+    noncontig = [name for name, a in
+                 (("table", table),
+                  *((f"state[{i}]", s) for i, s in enumerate(state)
+                    if getattr(s, "ndim", 0) >= 1))
+                 if not a.flags["C_CONTIGUOUS"]]
+    if noncontig:
+        raise ValueError(
+            f"host_apply_rows_inplace requires C-contiguous buffers; "
+            f"{noncontig} are not (pass np.ascontiguousarray copies and "
+            "write them back, or fix the caller's layout)")
     n, w = sums.shape
     lr = float(lr)
     rep = np.ascontiguousarray(rep, dtype=np.int32)

@@ -1,0 +1,21 @@
+"""Serving subsystem: apply-only inference over trained embedding models.
+
+Three pieces (see docs/serving.md for the lifecycle and consistency
+contract):
+
+  * `InferenceEngine` (engine.py) — apply-only, compile-ahead jitted
+    forward over a `DistributedEmbedding` (+ optional dense model);
+    strips optimizer state and tap machinery from the serving path.
+  * `HotRowCache` (cache.py) — software-managed HBM cache of the hot rows
+    of host-offloaded buckets, with counter-based admission and an
+    explicit `refresh()` consistency step.
+  * `MicroBatcher` (batcher.py) — coalesces variable-size requests into
+    the engine's padded shapes and records serving metrics (latency
+    percentiles, occupancy, hit rate).
+"""
+
+from distributed_embeddings_tpu.serving.batcher import MicroBatcher
+from distributed_embeddings_tpu.serving.cache import HotRowCache
+from distributed_embeddings_tpu.serving.engine import InferenceEngine
+
+__all__ = ["InferenceEngine", "HotRowCache", "MicroBatcher"]

@@ -1,0 +1,377 @@
+"""HBM hot-row cache for host-offloaded embedding buckets.
+
+Production recommender traffic is power-law distributed: a few thousand hot
+rows absorb most lookups (the DLRM/Criteo zipfian regime). For buckets past
+the device-memory budget the library keeps tables in host memory
+(`pinned_host`) and serves every lookup through a host round trip — correct,
+but the hot head of the distribution pays the host-memory latency on every
+request. `HotRowCache` is the serving-side fix: a fixed-capacity,
+device-resident (HBM) tensor of cached rows plus a host-maintained id→slot
+index, so the hot rows are gathered at HBM bandwidth and only the cold tail
+touches host memory.
+
+Design:
+
+  * **Device side** — `slots`: a `[capacity, width]` f32 tensor of cached
+    rows, replicated over the mesh (it is small by construction). The
+    forward uses a masked two-source gather
+    (`ops.embedding_ops.masked_two_source_gather`): lanes whose slot index
+    is >= 0 read their row from `slots` in HBM; miss lanes read from the
+    host-resident table inside a `compute_on("device_host")` region with
+    their hit lanes' ids clamped to row 0, so a cache hit never generates
+    table traffic in host memory.
+  * **Host side** — the id→slot index, per-row access counters, and the
+    admission policy. Admission is counter-based: a row is promoted into a
+    free slot once its access count crosses `promote_threshold`; when the
+    cache is full, a candidate evicts the coldest resident row only if the
+    candidate's count is strictly higher. All host structures are plain
+    numpy/dicts — the cache never syncs device state to make a decision.
+  * **Consistency** — cached rows are bit-exact copies of table rows taken
+    at promotion/refresh time. The cache does NOT observe table updates:
+    after a training step mutates an offloaded table, serving reads are
+    stale until `refresh(table)` re-copies every resident row (see
+    docs/serving.md for the full contract).
+
+Rows are keyed by ``world_slice * rows_max + local_row`` — the stacked
+bucket layout `[world, rows_max, width]` gives every world slice its own row
+space, so the flat key is the unique global row identity.
+"""
+
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_embeddings_tpu.ops import sparse_update as sparse_update_ops
+from distributed_embeddings_tpu.ops.embedding_ops import (
+    masked_two_source_gather, miss_only_ids)
+
+__all__ = ["HotRowCache", "cached_group_lookup"]
+
+
+def _ceil_pow2(n: int) -> int:
+    return 1 << max(int(n - 1).bit_length(), 0)
+
+
+class HotRowCache:
+    """Software-managed HBM cache over one offloaded bucket's rows.
+
+    Args:
+      emb: the `DistributedEmbedding` owning the bucket.
+      bucket: index into ``emb.plan.tp_buckets`` (must be offloaded).
+      capacity: number of rows the HBM tensor holds (static).
+      promote_threshold: access count at which a row becomes
+        promotion-eligible (>= 1; 1 promotes on first touch).
+    """
+
+    def __init__(self, emb, bucket: int, capacity: int,
+                 promote_threshold: int = 2,
+                 max_tracked: Optional[int] = None):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        if promote_threshold < 1:
+            raise ValueError("promote_threshold must be >= 1")
+        bk = emb.plan.tp_buckets[bucket]
+        if not (bk.offload and emb._offload_enabled):
+            raise ValueError(
+                f"bucket {bucket} is not host-offloaded; a hot-row cache "
+                "only makes sense over a host-resident table")
+        self.emb = emb
+        self.bucket = bucket
+        self.capacity = int(capacity)
+        self.promote_threshold = int(promote_threshold)
+        self.width = bk.width
+        self.rows_max = max(bk.rows_max, 1)
+
+        self._index: Dict[int, int] = {}          # row key -> slot
+        self._slot_keys = np.full((capacity,), -1, np.int64)
+        self._counts: Dict[int, int] = {}         # row key -> access count
+        # long-lived servers see unbounded unique ids: counters are pruned
+        # back to the hottest max_tracked/2 (plus residents) whenever the
+        # dict exceeds max_tracked, and promotion scans only the keys that
+        # actually crossed the threshold (`_pending`), never the full dict
+        self.max_tracked = int(max_tracked or max(64 * capacity, 4096))
+        self._pending: set = set()                # threshold-crossed keys
+        self._slots_np = np.zeros((capacity, self.width), np.float32)
+        self._slots = self._put_slots()
+        self._reader_cache: dict = {}
+        # stats (valid lanes only — exchange-padding lanes never count)
+        self.hits = 0
+        self.misses = 0
+        self.promotions = 0
+        self.evictions = 0
+        self.refreshes = 0
+
+    # ------------------------------------------------------------ device IO
+    def _put_slots(self):
+        """Stage the numpy slot mirror as the replicated device tensor."""
+        if self.emb.mesh is not None:
+            sh = NamedSharding(self.emb.mesh, P())
+        else:
+            sh = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        return jax.device_put(self._slots_np, sh)
+
+    def _update_slots(self, slot_idx, rows) -> None:
+        """Write `rows` into slots `slot_idx` on host mirror AND device —
+        only the changed rows cross the host->device link (a full
+        [capacity, width] re-upload per promotion would put a
+        capacity-sized transfer on the serving latency path)."""
+        self._slots_np[slot_idx] = rows
+        self._slots = self._slots.at[jnp.asarray(slot_idx)].set(
+            jnp.asarray(rows, jnp.float32))
+
+    @property
+    def slots(self) -> jax.Array:
+        """The device-resident `[capacity, width]` row tensor (pass into the
+        jitted forward next to the slot indices)."""
+        return self._slots
+
+    def _read_rows(self, table: jax.Array, keys: np.ndarray) -> np.ndarray:
+        """Fetch table rows for `keys` ([M] int64) host-side, via a cached
+        jitted gather in the table's host memory space (rows-only traffic —
+        the bucket itself never moves)."""
+        world = self.emb.world_size
+        m_pad = _ceil_pow2(max(len(keys), 1))
+        ids = np.zeros((world, m_pad), np.int32)
+        w_idx = (keys // self.rows_max).astype(np.int64)
+        rows = (keys % self.rows_max).astype(np.int32)
+        pos = np.arange(len(keys))
+        ids[w_idx, pos] = rows
+        fn = self._reader_cache.get(m_pad)
+        if fn is None:
+            emb = self.emb
+            if emb.mesh is not None:
+                host_sh = NamedSharding(emb.mesh, P(emb.axis),
+                                        memory_kind=emb._host_kind)
+            else:
+                host_sh = jax.sharding.SingleDeviceSharding(
+                    jax.devices()[0], memory_kind=emb._host_kind)
+
+            def run(table_h, ids):
+                ids_h = jax.device_put(ids, host_sh)
+                from jax.experimental import compute_on
+                with compute_on.compute_on("device_host"):
+                    return jax.vmap(sparse_update_ops.take_rows)(
+                        table_h, ids_h)
+
+            fn = jax.jit(run)
+            self._reader_cache[m_pad] = fn
+        out = np.asarray(jax.device_get(fn(table, ids)))   # [world, Mp, w]
+        return out[w_idx, pos]
+
+    # ------------------------------------------------------- host-side index
+    def lookup_slots(self, keys: np.ndarray,
+                     valid: Optional[np.ndarray] = None,
+                     observe: bool = True) -> np.ndarray:
+        """Map global row keys to cache slots: >= 0 on hit, -1 on miss.
+
+        Args:
+          keys: int64 array (any shape) of ``world*rows_max + row`` keys.
+          valid: optional same-shape bool mask; invalid lanes (exchange
+            padding) always map to -1 and never touch counters or stats.
+          observe: update access counters + hit/miss stats (warmup passes
+            set False so compile-ahead does not skew admission).
+
+        Returns an int32 array of `keys`' shape.
+        """
+        flat = np.asarray(keys, np.int64).reshape(-1)
+        vmask = (np.ones(flat.shape, bool) if valid is None
+                 else np.asarray(valid, bool).reshape(-1))
+        out = np.full(flat.shape, -1, np.int32)
+        uniq, inv, counts = np.unique(flat[vmask], return_inverse=True,
+                                      return_counts=True)
+        slot_of = np.full(uniq.shape, -1, np.int32)
+        for u, key in enumerate(uniq.tolist()):
+            s = self._index.get(key)
+            if s is not None:
+                slot_of[u] = s
+            if observe:
+                c = self._counts.get(key, 0) + int(counts[u])
+                self._counts[key] = c
+                if s is None and c >= self.promote_threshold:
+                    self._pending.add(key)
+        if observe and len(self._counts) > self.max_tracked:
+            self._prune_counts()
+        out[vmask] = slot_of[inv]
+        if observe:
+            n_hit = int((out[vmask] >= 0).sum())
+            self.hits += n_hit
+            self.misses += int(vmask.sum()) - n_hit
+        return out.reshape(np.asarray(keys).shape)
+
+    def _prune_counts(self) -> None:
+        """Bound the counter dict: keep resident keys plus the hottest
+        half of max_tracked; everything colder restarts from zero if seen
+        again (an admissible information loss — a pruned key was, by
+        construction, colder than max_tracked/2 other keys)."""
+        resident = set(self._index)
+        keep_n = self.max_tracked // 2
+        hottest = sorted(self._counts.items(), key=lambda kv: -kv[1])[:keep_n]
+        kept = {k: c for k, c in hottest}
+        for k in resident:
+            if k in self._counts:
+                kept[k] = self._counts[k]
+        self._counts = kept
+        self._pending &= set(kept)
+
+    def _promotion_candidates(self):
+        """Uncached keys whose count crossed the threshold, hottest first —
+        drawn from the `_pending` set, not a full counter scan."""
+        self._pending -= set(self._index)
+        cands = [(self._counts.get(k, 0), k) for k in self._pending]
+        cands.sort(reverse=True)
+        return cands
+
+    def admit(self, table: jax.Array) -> int:
+        """Run the admission policy against the current counters, copying
+        newly-promoted rows out of `table`. Returns rows promoted."""
+        cands = self._promotion_candidates()
+        if not cands:
+            return 0
+        free = [s for s in range(self.capacity) if self._slot_keys[s] < 0]
+        plan = []                                  # (slot, key)
+        for count, key in cands:
+            if free:
+                slot = free.pop()
+            else:
+                # full: evict the coldest resident only for a strictly
+                # hotter row. Slots planned earlier this round already
+                # carry their NEW key (assigned below), so the scan ranks
+                # them by the newcomer's count, never as empty.
+                coldest = min(range(self.capacity),
+                              key=lambda s: self._counts.get(
+                                  int(self._slot_keys[s]), 0))
+                cold_key = int(self._slot_keys[coldest])
+                if count <= self._counts.get(cold_key, 0):
+                    break                          # sorted: nothing hotter left
+                self._index.pop(cold_key, None)
+                self.evictions += 1
+                slot = coldest
+            self._slot_keys[slot] = key
+            plan.append((slot, key))
+        if not plan:
+            return 0
+        keys = np.asarray([k for _, k in plan], np.int64)
+        rows = self._read_rows(table, keys)
+        self._update_slots(np.asarray([s for s, _ in plan]), rows)
+        for slot, key in plan:
+            self._index[key] = slot
+            self._pending.discard(key)
+        self.promotions += len(plan)
+        return len(plan)
+
+    def refresh(self, table: jax.Array) -> int:
+        """Re-copy every resident row from `table` into the HBM slots —
+        REQUIRED after anything mutates the offloaded table (see the
+        consistency contract in docs/serving.md). Returns rows refreshed."""
+        resident = np.flatnonzero(self._slot_keys >= 0)
+        if len(resident):
+            rows = self._read_rows(table, self._slot_keys[resident])
+            self._update_slots(resident, rows)
+        self.refreshes += 1
+        return int(len(resident))
+
+    def invalidate(self) -> None:
+        """Drop every resident row (hits resume only after re-admission)."""
+        for k in self._index:
+            if self._counts.get(k, 0) >= self.promote_threshold:
+                self._pending.add(k)       # still hot: re-promotable
+        self._index.clear()
+        self._slot_keys.fill(-1)
+
+    # ---------------------------------------------------------------- stats
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> dict:
+        return {"bucket": self.bucket, "capacity": self.capacity,
+                "resident": int((self._slot_keys >= 0).sum()),
+                "hits": self.hits, "misses": self.misses,
+                "hit_rate": round(self.hit_rate, 4),
+                "promotions": self.promotions, "evictions": self.evictions,
+                "refreshes": self.refreshes}
+
+
+def cached_group_lookup(emb, grp, table_h, slots, ids_g, slot_g, w_g):
+    """One offloaded exchange group's output through the hot-row cache.
+
+    The numerics mirror ``DistributedEmbedding._host_group_exchange``
+    step for step (same reshapes, same weighted-sum expression, same
+    cast-then-scale order) so cached and uncached serving outputs bit-match;
+    the only difference is the row source: hit lanes gather from the HBM
+    `slots` tensor, miss lanes from the host table with hit ids clamped to
+    row 0 (`miss_only_ids`) so hits generate no host-memory table traffic.
+
+    Transfer trade-off (deliberate): the stock host path combines on host
+    and streams `[world, B, f, wf]` COMBINED rows device-ward; here the
+    combine must run on device (hit rows already live there), so the
+    miss-side stream is `[world, B*f*k, wf]` RAW rows — k× more
+    host->device bytes for multi-hot combiner buckets. Splitting the
+    combine (host-partial for misses + device-partial for hits) would undo
+    that but changes float summation order and breaks the bit-exactness
+    contract above, which is the stronger requirement. What the cache
+    buys is host *table* bandwidth on the hot head — the resource the
+    offloaded regime is actually starved of; one-hot buckets (k=1, the
+    DLRM/Criteo shape) see no stream inflation at all.
+
+    Args (all traced):
+      table_h: [world, rows_max, width] host-resident bucket.
+      slots: [capacity, width] device-resident cached rows.
+      ids_g: [world, B, f, k] exchanged absolute rows (per world slice).
+      slot_g: [world, B*f*k] int32 slot indices (-1 = miss).
+      w_g: [world, B, f, k] effective weights or None.
+
+    Returns [world, B, f, w_out] matching `_tp_bucket_exchange` layout.
+    """
+    from jax.experimental import compute_on
+
+    bucket = emb.plan.tp_buckets[grp.bucket]
+    world = emb.world_size
+    k, wf = grp.k, bucket.width
+    rows_max = max(bucket.rows_max, 1)
+    combiner = bucket.combiner
+    if w_g is None:
+        from distributed_embeddings_tpu.layers.dist_model_parallel import (
+            _effective_weights)
+        _, scale = _effective_weights(None, k, combiner)
+    else:
+        scale = 1.0
+    if emb.mesh is not None:
+        host_sh = NamedSharding(emb.mesh, P(emb.axis),
+                                memory_kind=emb._host_kind)
+        dev_sh = NamedSharding(emb.mesh, P(emb.axis))
+    else:
+        dev0 = jax.devices()[0]
+        host_sh = jax.sharding.SingleDeviceSharding(
+            dev0, memory_kind=emb._host_kind)
+        dev_sh = jax.sharding.SingleDeviceSharding(dev0)
+
+    B, f = ids_g.shape[1], ids_g.shape[2]
+    ids = jnp.clip(ids_g, 0, rows_max - 1).reshape(world, -1)
+    hit = slot_g >= 0
+    # miss lanes keep their id; hit lanes read host row 0 only (never the
+    # hit row) — the host table sees no traffic proportional to hits
+    ids_h = jax.device_put(miss_only_ids(ids, slot_g), host_sh)
+    with compute_on.compute_on("device_host"):
+        miss_rows_h = jax.vmap(sparse_update_ops.take_rows)(table_h, ids_h)
+    miss_rows = jax.device_put(miss_rows_h, dev_sh)        # [world, N, wf]
+    rows = masked_two_source_gather(slots, slot_g, miss_rows)
+    if combiner is None:
+        out = rows.reshape(world, B, f, k * wf)
+    else:
+        rows = rows.reshape(world, B * f, k, wf)
+        out = (rows if w_g is None
+               else rows * w_g.reshape(world, B * f, k)[..., None]).sum(axis=2)
+        out = out.reshape(world, B, f, wf)
+    out = emb._cast(out)
+    if scale != 1.0:
+        out = out * jnp.asarray(scale, out.dtype)
+    if emb.mesh is not None and world > 1:
+        out = lax.with_sharding_constraint(
+            out, NamedSharding(emb.mesh, P(None, emb.axis)))
+    return out

@@ -1,0 +1,349 @@
+"""Apply-only inference engine over a DistributedEmbedding (+ dense model).
+
+Training forwards in this library drag machinery a serving path never
+needs: tap perturbations and their residual exports, optimizer state
+threading, and a host round trip for every offloaded-bucket lookup.
+`InferenceEngine` is the serving half of the ROADMAP's north star — an
+apply-only wrapper that:
+
+  * holds ONLY parameters (anything shaped like a checkpoint's
+    ``{"params": ..., "opt_state": ...}`` is stripped to its params on the
+    way in);
+  * freezes the exchange plan: exchange groups are resolved once per input
+    signature and the whole forward (dense model + embedding exchange +
+    lookups) is one jit-compiled program per padded batch shape, with
+    ``warmup()`` compile-ahead for the shapes the batcher will use;
+  * serves offloaded buckets through the HBM hot-row cache
+    (`serving/cache.py`) plugged into the layer's
+    ``offload_lookup_scope`` seam — hot rows gather at HBM bandwidth, only
+    the cold tail pays the host round trip;
+  * pads every request batch to the nearest prepared shape (a static-shape
+    requirement on TPU) and slices the true rows back out.
+
+Consistency: the engine snapshots nothing — it reads whatever `params` it
+currently holds. After training mutates tables, call ``set_params(new)``;
+cached hot rows are STALE until ``refresh()`` re-copies them (see
+docs/serving.md for the contract).
+"""
+
+import math
+from typing import Dict, List, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    DistributedEmbedding)
+from distributed_embeddings_tpu.serving.cache import (HotRowCache,
+                                                      cached_group_lookup)
+
+__all__ = ["InferenceEngine"]
+
+
+class _NpInput:
+    """Host-side normalized input: ids [B, k] int64 (+ weights or None),
+    plus the original array to feed the traced forward."""
+
+    __slots__ = ("ids", "weights", "k", "orig")
+
+    def __init__(self, ids, weights, k, orig):
+        self.ids = ids
+        self.weights = weights
+        self.k = k
+        self.orig = orig
+
+
+class InferenceEngine:
+    """Serve ``predict(batch)`` from a trained model at inference cost.
+
+    Args:
+      model: either a `DistributedEmbedding` (embedding-only serving —
+        `predict` takes the per-feature id batch and returns the per-input
+        embedding outputs) or an object exposing ``.embedding`` (a
+        `DistributedEmbedding`) and ``.apply(params, numerical, cats)``
+        (e.g. `models.dlrm.DLRM`) — `predict` then takes
+        ``(numerical, cats)`` and returns the model output.
+      params: the parameter pytree — the embedding params pytree in
+        embedding-only mode, the full model params otherwise. A
+        ``{"params": ..., "opt_state": ...}`` checkpoint dict is accepted
+        and stripped to its params.
+      cache_capacity: rows of HBM cache per offloaded bucket (0 = no
+        caching; lookups keep the stock host path). A dict
+        ``{bucket_index: capacity}`` caches selected buckets only.
+      promote_threshold: access count before a row is promotion-eligible.
+      donate_batch: donate the staged request buffers to the compiled
+        forward (saves an HBM copy per request; leave False where the
+        caller reuses its input arrays).
+    """
+
+    def __init__(self, model, params, *, cache_capacity=0,
+                 promote_threshold: int = 2, donate_batch: bool = False):
+        if isinstance(model, DistributedEmbedding):
+            self._model = None
+            self.embedding = model
+        else:
+            self._model = model
+            self.embedding = model.embedding
+        if not self.embedding.dp_input:
+            raise ValueError(
+                "InferenceEngine serves data-parallel input batches; this "
+                "layer was built with dp_input=False")
+        if isinstance(params, dict) and "params" in params \
+                and "opt_state" in params:
+            params = params["params"]      # checkpoint dict: strip opt state
+        self.params = params
+
+        emb = self.embedding
+        self.caches: Dict[int, HotRowCache] = {}
+        if emb._offload_enabled:
+            off = [b for b, bk in enumerate(emb.plan.tp_buckets)
+                   if bk.offload]
+            if isinstance(cache_capacity, dict):
+                caps = {b: cache_capacity.get(b, 0) for b in off}
+            else:
+                caps = {b: int(cache_capacity) for b in off}
+            for b, cap in caps.items():
+                if cap > 0:
+                    self.caches[b] = HotRowCache(
+                        emb, b, cap, promote_threshold=promote_threshold)
+        self._warmed: List[int] = []
+        self._jit_fwd = jax.jit(
+            self._fwd, donate_argnums=(1,) if donate_batch else ())
+        self.n_predicts = 0
+        self.rows_served = 0
+        self.rows_padded = 0
+
+    # ------------------------------------------------------------ internals
+    def _emb_params(self, params):
+        return params if self._model is None else params["embedding"]
+
+    def _normalize(self, cats: Sequence) -> List[_NpInput]:
+        emb = self.embedding
+        if len(cats) != emb._n_inputs:
+            raise ValueError(
+                f"expected {emb._n_inputs} categorical inputs, "
+                f"got {len(cats)}")
+        out = []
+        for i, x in enumerate(cats):
+            weights = None
+            if isinstance(x, tuple) and len(x) == 2:
+                x, weights = x
+                weights = np.asarray(weights, np.float32)
+            ids = np.asarray(x)
+            if not np.issubdtype(ids.dtype, np.integer):
+                raise TypeError(
+                    f"input {i}: serving takes integer id arrays "
+                    f"(or (ids, weights) tuples), got dtype {ids.dtype}")
+            ids2 = ids[:, None] if ids.ndim == 1 else ids
+            if ids2.ndim != 2:
+                raise ValueError(
+                    f"input {i}: expected [B] or [B, k] ids, "
+                    f"got shape {ids.shape}")
+            orig = (ids, weights) if weights is not None else ids
+            out.append(_NpInput(ids2.astype(np.int64), weights,
+                                ids2.shape[1], orig))
+        return out
+
+    def _pad_rows(self, arr: np.ndarray, target: int) -> np.ndarray:
+        b = arr.shape[0]
+        if b == target:
+            return arr
+        pad = np.zeros((target - b,) + arr.shape[1:], arr.dtype)
+        return np.concatenate([arr, pad], axis=0)
+
+    def _target_batch(self, b: int) -> int:
+        for size in self._warmed:
+            if size >= b:
+                return size
+        world = max(self.embedding.world_size, 1)
+        return int(math.ceil(b / world) * world)
+
+    def _tp_key(self, prepped: List[_NpInput]):
+        emb = self.embedding
+        tp = [prepped[i] for i in emb.strategy.input_groups[1]]
+        return tuple((p.k, p.weights is not None) for p in tp), tp
+
+    def _off_groups(self, key):
+        """(g, grp) for offloaded exchange groups with a cache attached."""
+        emb = self.embedding
+        groups, _ = emb._exchange_groups_for_key(key)
+        return [(g, grp) for g, grp in enumerate(groups)
+                if emb.plan.tp_buckets[grp.bucket].offload
+                and emb._offload_enabled and grp.bucket in self.caches]
+
+    def _group_keys(self, grp, tp_prepped, batch, true_rows):
+        """Host mirror of the on-device dp->mp id exchange for one group:
+        the global row keys [world, B*f*k] each destination shard will look
+        up, plus the validity mask (False on exchange-padding lanes and on
+        batch-padding rows — those never reach a consumed output slot)."""
+        emb = self.embedding
+        world = emb.world_size
+        rows_max = max(emb.plan.tp_buckets[grp.bucket].rows_max, 1)
+        ids = np.zeros((world, batch, grp.f_max, grp.k), np.int64)
+        valid = np.zeros((world, batch, grp.f_max, grp.k), bool)
+        for r in range(world):
+            for j in range(len(grp.rank_slots[r])):
+                i = grp.class_inputs[int(grp.sel[r, j])]
+                member = tp_prepped[i].ids          # [b, k], b <= batch
+                ids[r, :member.shape[0], j, :] = (member
+                                                  + int(grp.offs[r, j]))
+                valid[r, :true_rows, j, :] = True
+        np.clip(ids, 0, rows_max - 1, out=ids)
+        keys = ids + (np.arange(world, dtype=np.int64)[:, None, None, None]
+                      * rows_max)
+        return keys.reshape(world, -1), valid.reshape(world, -1)
+
+    def _fwd(self, params, batch, slot_map, slots_map):
+        numerical, cats = batch
+        emb = self.embedding
+
+        def hook(g, grp, table, ids_g, w_g):
+            slot_g = slot_map.get(g)
+            if slot_g is None:
+                return None
+            return cached_group_lookup(emb, grp, table,
+                                       slots_map[grp.bucket], ids_g,
+                                       slot_g, w_g)
+
+        with emb.offload_lookup_scope(hook):
+            if self._model is None:
+                return emb.apply(params, cats)
+            return self._model.apply(params, numerical, cats)
+
+    def _predict_padded(self, numerical, prepped, target, true_rows,
+                        observe=True):
+        emb = self.embedding
+        key, tp_prepped = self._tp_key(prepped)
+        emb_params = self._emb_params(self.params)
+        slot_map, slots_map = {}, {}
+        for g, grp in self._off_groups(key):
+            cache = self.caches[grp.bucket]
+            if observe:
+                # admit on the counters accumulated so far, so this batch
+                # already hits rows that just crossed the threshold
+                cache.admit(emb_params["tp"][grp.bucket])
+            keys, valid = self._group_keys(grp, tp_prepped, target, true_rows)
+            slot_map[g] = jnp.asarray(
+                cache.lookup_slots(keys, valid, observe=observe))
+            slots_map[grp.bucket] = cache.slots
+        cats = [jnp.asarray(self._pad_rows(np.asarray(p.orig[0]), target))
+                if isinstance(p.orig, tuple)
+                else jnp.asarray(self._pad_rows(p.orig, target))
+                for p in prepped]
+        for i, p in enumerate(prepped):
+            if isinstance(p.orig, tuple):
+                cats[i] = (cats[i],
+                           jnp.asarray(self._pad_rows(p.orig[1], target)))
+        num = (None if numerical is None
+               else jnp.asarray(self._pad_rows(np.asarray(numerical),
+                                               target)))
+        return self._jit_fwd(self.params, (num, cats), slot_map, slots_map)
+
+    # --------------------------------------------------------------- API
+    def predict(self, batch):
+        """Serve one request batch.
+
+        Args:
+          batch: embedding-only mode — the list of per-feature id arrays
+            ([B] / [B, k] ints, or (ids, weights) tuples); model mode — a
+            ``(numerical, cats)`` tuple.
+
+        Returns the forward output(s) sliced to the request's true batch
+        size (model output array, or one array per embedding input).
+        """
+        if self._model is None:
+            numerical, cats = None, list(batch)
+        else:
+            numerical, cats = batch
+            cats = list(cats)
+        prepped = self._normalize(cats)
+        b = prepped[0].ids.shape[0]
+        target = self._target_batch(b)
+        out = self._predict_padded(numerical, prepped, target, b)
+        self.n_predicts += 1
+        self.rows_served += b
+        self.rows_padded += target - b
+        return jax.tree.map(lambda a: a[:b], out)
+
+    def warmup(self, batch_sizes: Sequence[int], example=None) -> List[int]:
+        """Compile-ahead for a fixed set of padded batch shapes.
+
+        Args:
+          batch_sizes: the shapes `predict` will pad to (each is rounded up
+            to a multiple of the mesh size). Kept sorted; `predict` pads to
+            the smallest warmed shape that fits.
+          example: an example `predict` batch whose per-input structure
+            (hotness, weights, dtypes) matches real traffic; required when
+            the layer has no `input_max_hotness` hints and inputs are
+            multi-hot. Default: hotness-1 int32 ids (1-D), zeros.
+
+        Returns the warmed sizes. Warmup forwards do NOT touch cache
+        counters or stats.
+        """
+        emb = self.embedding
+        world = max(emb.world_size, 1)
+        sizes = sorted({int(math.ceil(b / world) * world)
+                        for b in batch_sizes})
+        for size in sizes:
+            if example is not None:
+                if self._model is None:
+                    numerical, cats = None, list(example)
+                else:
+                    numerical, cats = example
+                # an example larger than this warm size is cut down to it
+                # (only its per-input STRUCTURE matters here); smaller ones
+                # pad up inside _predict_padded as usual
+                cut = lambda a: np.asarray(a)[:size]
+                cats = [(cut(x[0]), cut(x[1])) if isinstance(x, tuple)
+                        else cut(x) for x in cats]
+                prepped = self._normalize(list(cats))
+                num = None if numerical is None else cut(numerical)
+            else:
+                mh = emb.input_max_hotness or [None] * emb._n_inputs
+                cats = [np.zeros((size,), np.int32) if (h or 1) == 1
+                        else np.zeros((size, h), np.int32) for h in mh]
+                prepped = self._normalize(cats)
+                num = (None if self._model is None
+                       else np.zeros((size, getattr(
+                           self._model, "num_numerical_features", 1)),
+                           np.float32))
+            self._predict_padded(num, prepped, size, size, observe=False)
+        # merge with earlier warmups: shapes already compiled must stay
+        # padding targets, or a later warmup([small]) would silently send
+        # big requests to an unwarmed (compile-on-request) shape
+        self._warmed = sorted(set(self._warmed) | set(sizes))
+        return self._warmed
+
+    def set_params(self, params, refresh: bool = False) -> None:
+        """Swap in new parameters (e.g. after training steps). Cached hot
+        rows still hold the OLD table values until `refresh()` — pass
+        refresh=True (or call it explicitly) whenever bit-exact serving
+        matters more than the swap latency."""
+        if isinstance(params, dict) and "params" in params \
+                and "opt_state" in params:
+            params = params["params"]
+        self.params = params
+        if refresh:
+            self.refresh()
+
+    def refresh(self) -> int:
+        """Re-copy every cached row from the current tables (the explicit
+        cache-consistency step after table mutation). Returns total rows
+        refreshed across buckets."""
+        emb_params = self._emb_params(self.params)
+        return sum(cache.refresh(emb_params["tp"][b])
+                   for b, cache in self.caches.items())
+
+    def cache_stats(self) -> dict:
+        """Aggregate + per-bucket cache statistics."""
+        per = {b: c.stats() for b, c in self.caches.items()}
+        hits = sum(c.hits for c in self.caches.values())
+        misses = sum(c.misses for c in self.caches.values())
+        return {"hit_rate": round(hits / (hits + misses), 4)
+                if hits + misses else 0.0,
+                "hits": hits, "misses": misses,
+                "n_predicts": self.n_predicts,
+                "rows_served": self.rows_served,
+                "rows_padded": self.rows_padded,
+                "buckets": per}

@@ -97,7 +97,11 @@ def checkpoint_keys(path: str,
         meta = _checkpointer().metadata(target)
     except Exception:  # noqa: BLE001 - metadata layout varies across orbax
         return None
-    tree = getattr(getattr(meta, "item_metadata", meta), "tree", None)
+    item = getattr(meta, "item_metadata", meta)
+    tree = getattr(item, "tree", None)
+    if not isinstance(tree, dict):
+        # older orbax returns the metadata tree as the bare mapping
+        tree = item if isinstance(item, dict) else None
     if not isinstance(tree, dict):
         return None
     return sorted(tree)

@@ -179,6 +179,10 @@ def test_data_parallel():
                       data_parallel_threshold=500)
 
 
+# execution-bound on the single-core CPU test host (see
+# .claude/skills/verify/SKILL.md): runs in the `-m slow` tier so the
+# not-slow tier-1 sweep completes inside its time budget
+@pytest.mark.slow
 def test_all_parallelism_modes():
     specs = [(10, 4), (96, 8), (50, 8), (1000, 16), (2000, 16), (30, 4),
              (800, 8), (64, 8)]
@@ -526,6 +530,10 @@ def test_bf16_column_slice():
                       compute_dtype=jnp.bfloat16, **BF16_TOL)
 
 
+# execution-bound on the single-core CPU test host (see
+# .claude/skills/verify/SKILL.md): runs in the `-m slow` tier so the
+# not-slow tier-1 sweep completes inside its time budget
+@pytest.mark.slow
 def test_bf16_row_slice():
     check_equivalence(ONE_HOT_8, strategy="memory_balanced",
                       row_slice_threshold=1600,

@@ -104,3 +104,21 @@ def test_unknown_kind_rejected():
     with pytest.raises(NotImplementedError):
         sparse_update.host_apply_rows_inplace("rmsprop", table, (), rep,
                                               sums, valid, 0.05)
+
+
+def test_rejects_noncontiguous_buffers():
+    """ADVICE r5: the in-place apply consumes raw pointers with dense
+    row-major stride assumptions — non-contiguous views must be refused,
+    not silently corrupted."""
+    table, rep, sums, valid = _rows(11)
+    bad_table = np.asfortranarray(table)
+    assert not bad_table.flags["C_CONTIGUOUS"]
+    with pytest.raises(ValueError, match="C-contiguous"):
+        sparse_update.host_apply_rows_inplace(
+            "sgd", bad_table, (), rep, sums, valid, 0.1)
+    acc = np.zeros_like(table)
+    bad_acc = acc[:, ::2]                       # strided state view
+    with pytest.raises(ValueError, match="C-contiguous"):
+        sparse_update.host_apply_rows_inplace(
+            "adagrad", table, (bad_acc,), rep, sums[:, ::2].copy(),
+            valid, 0.1)

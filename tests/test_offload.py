@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+from distributed_embeddings_tpu import compat
 from distributed_embeddings_tpu.layers.embedding import Embedding
 from distributed_embeddings_tpu.layers.dist_model_parallel import (
     DistributedEmbedding)
@@ -27,6 +28,12 @@ SPECS = [(5000, 16, "sum"), (40, 16, "sum"), (5000, 16, "sum"),
          (80, 16, "sum"), (72, 16, "sum")]
 # total tp elements ~ 166k: a 40k budget forces the two 5000-row tables out
 BUDGET = 2500 * 16
+
+# expected memory spaces, derived from the backend: pinned_host vs device on
+# TPU; older XLA:CPU has a single unpinned_host space (placement is a no-op
+# there but the offload code path still runs end to end)
+HOST_KIND = compat.host_memory_kind(jax.devices()[0])
+DEV_KIND = compat.default_memory_kind(jax.devices()[0])
 
 
 def _build(mesh, offload: bool, **kw):
@@ -49,10 +56,10 @@ def test_offload_placement_and_forward():
     p_off = dist_off.set_weights(weights)
     p_dev = dist_dev.set_weights(weights)
 
-    # device-memory exclusion: offloaded buckets are pinned-host arrays
+    # device-memory exclusion: offloaded buckets are host-space arrays
     for b, bk in enumerate(dist_off.plan.tp_buckets):
         kind = p_off["tp"][b].sharding.memory_kind
-        assert kind == ("pinned_host" if bk.offload else "device"), \
+        assert kind == (HOST_KIND if bk.offload else DEV_KIND), \
             f"bucket {b}: {kind}"
 
     inputs = [jnp.asarray(rng.randint(0, v, size=(BATCH, 2)))
@@ -243,7 +250,7 @@ def test_offload_checkpoint_roundtrip(tmp_path):
                                        shardings=dist.param_shardings())
     for b in range(len(dist.plan.tp_buckets)):
         kind = restored["tp"][b].sharding.memory_kind
-        assert kind == ("pinned_host" if b in off_buckets else "device")
+        assert kind == (HOST_KIND if b in off_buckets else DEV_KIND)
 
     inputs = [jnp.asarray(rng.randint(0, v, size=(BATCH,)).astype(np.int32))
               for v, _, _ in SPECS]
@@ -279,13 +286,17 @@ def test_multibucket_offload_device_bytes_excluded():
                    if x.sharding.memory_kind == kind)
 
     total = sum(x.nbytes for x in jax.tree.leaves(params))
-    host_bytes = tree_bytes(params, "pinned_host")
-    dev_bytes = tree_bytes(params, "device")
+    host_bytes = tree_bytes(params, HOST_KIND)
+    dev_bytes = tree_bytes(params, DEV_KIND)
     off_bytes = sum(params["tp"][b].nbytes for b in off)
-    # placed buffers: device total excludes exactly the offloaded buckets
-    assert host_bytes == off_bytes
-    assert dev_bytes == total - off_bytes
-    assert off_bytes > 10 * dev_bytes    # the offloaded part dominates
+    if HOST_KIND != DEV_KIND:
+        # placed buffers: device total excludes exactly the offloaded
+        # buckets (vacuous on backends with a single memory space)
+        assert host_bytes == off_bytes
+        assert dev_bytes == total - off_bytes
+        assert off_bytes > 10 * dev_bytes  # the offloaded part dominates
+    else:
+        assert off_bytes > 10 * (total - off_bytes)
 
     # compiled forward: XLA's buffer assignment confirms the step streams
     # only combined rows device-ward — temps + outputs are orders of

@@ -171,6 +171,10 @@ def test_sparse_train_strategies(strategy):
     run_equivalence(SPECS_BASIC, "adagrad", strategy=strategy)
 
 
+# execution-bound on the single-core CPU test host (see
+# .claude/skills/verify/SKILL.md): runs in the `-m slow` tier so the
+# not-slow tier-1 sweep completes inside its time budget
+@pytest.mark.slow
 def test_sparse_train_multihot_combiners():
     specs = [(40, 4, "sum"), (60, 8, "mean"), (30, 4, "sum"), (50, 8, "mean"),
              (25, 4, "sum"), (70, 8, "sum"), (45, 4, "mean"), (35, 8, "sum")]
@@ -191,6 +195,10 @@ def test_sparse_train_row_slice():
                     atol=2e-4)
 
 
+# execution-bound on the single-core CPU test host (see
+# .claude/skills/verify/SKILL.md): runs in the `-m slow` tier so the
+# not-slow tier-1 sweep completes inside its time budget
+@pytest.mark.slow
 def test_sparse_train_hybrid_dp_col_row():
     specs = [(512, 8, "sum"), (300, 8, "sum"), (8, 4), (6, 4),
              (100, 8, "sum"), (90, 8, "sum"), (80, 8, "sum"), (70, 8, "sum"),
@@ -267,6 +275,10 @@ def test_sparse_train_ragged_inputs():
                     input_max_hotness=[6] * 8)
 
 
+# execution-bound on the single-core CPU test host (see
+# .claude/skills/verify/SKILL.md): runs in the `-m slow` tier so the
+# not-slow tier-1 sweep completes inside its time budget
+@pytest.mark.slow
 def test_sparse_train_weighted_inputs():
     rng_w = np.random.RandomState(99)
 
