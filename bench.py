@@ -315,7 +315,11 @@ def _maybe_write_measured_defaults(record: dict) -> None:
     override at use time. DET_DEDUP_IMPL is deliberately NOT auto-flipped:
     cumsum trades ~sqrt(N)*eps precision and weakens the rep promise — a
     wall-clock win alone must not change numerics defaults."""
-    if jax.devices()[0].platform == "cpu":
+    if (jax.devices()[0].platform == "cpu"
+            and os.environ.get("DET_BENCH_ALLOW_CPU_DEFAULTS_WRITE") != "1"):
+        # CPU runs never flip fleet defaults; the override exists solely so
+        # the unattended-window REHEARSAL (tools/window_rehearsal.py) can
+        # execute this exact writer against a scratch defaults path
         return
     tiny_best = record.get("tiny_best_path", "")
     dlrm_best = record.get("dlrm_best_path", "")
@@ -800,6 +804,25 @@ def main():
                     stats["peak_bytes_in_use"] / 2**30, 2)
         except Exception:  # noqa: BLE001 - never lose the primary metric
             pass
+        # sort-count fingerprint of the step being timed (ISSUE 2): lowering
+        # only (no compile), so it is tunnel-safe; a perf regression on
+        # hardware can then be attributed to (or cleared of) a re-sort
+        # regression from the same record
+        try:
+            import importlib.util as _ilu
+            _sp = _ilu.spec_from_file_location(
+                "det_hlo_audit", os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), "tools",
+                    "hlo_audit.py"))
+            _ha = _ilu.module_from_spec(_sp)
+            _sp.loader.exec_module(_ha)
+            record["hlo_sort_audit"] = [
+                _ha.audit_tapped_step(strategy="sort"),
+                _ha.audit_tapped_step(strategy="tiled",
+                                      lookup_path="tiled"),
+            ]
+        except Exception as e:  # noqa: BLE001 - audit must not kill bench
+            record["hlo_sort_audit_error"] = str(e)[:200]
         # lookup-path A/B (round-2 verdict item 2): tiny's widths (8/16)
         # are sub-lane, so the default path falls back to XLA gathers; the
         # contender is the forced Pallas path with the narrow-width DMA

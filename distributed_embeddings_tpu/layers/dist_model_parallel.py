@@ -33,6 +33,7 @@ padding is bounded by per-destination feature-count imbalance, which the
 planner's placement strategies already minimize.
 """
 
+import contextlib
 import logging
 import math
 import os
@@ -47,7 +48,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_embeddings_tpu import compat
 from distributed_embeddings_tpu.ops import embedding_ops, pallas_lookup
 from distributed_embeddings_tpu.ops import sparse_update as sparse_update_ops
-from distributed_embeddings_tpu.ops.embedding_ops import RaggedIds, SparseIds
+from distributed_embeddings_tpu.ops.embedding_ops import (GroupSort,
+                                                          RaggedIds,
+                                                          SparseIds,
+                                                          canonical_id_sort)
 from distributed_embeddings_tpu.ops.sparse_update import (SparseOptimizer,
                                                           SparseRowGrad,
                                                           concat_grads)
@@ -132,17 +136,31 @@ class TapResiduals:
     combine weights (None = uniform; the static scale is recomputed from the
     group metadata), and per row-sliced input the sentinel-masked local ids +
     effective weights. Registered as a pytree with the static exchange-group
-    cache key as aux data so `sparse_update` can rebuild the group layout."""
+    cache key as aux data so `sparse_update` can rebuild the group layout.
 
-    def __init__(self, key, tp_ids, tp_w, row_ids, row_w):
+    `tp_sort` / `row_sort` (sort folding, ISSUE 2): optionally one
+    `GroupSort` per exchange group / row input — the canonical sort of the
+    SAME id stream `tp_ids`/`row_ids` carries, produced once in the forward
+    (under `residual_sort_scope`) so the sparse update consumes the
+    precomputed order instead of re-sorting (the reference CUDA backward's
+    reuse of forward-sorted ids, embedding_lookup_kernels.cu:706-773).
+    None entries (or None lists — every pre-fold producer) mean "no
+    artifact"; consumers fall back to a fresh sort, so the field is
+    strictly additive."""
+
+    def __init__(self, key, tp_ids, tp_w, row_ids, row_w, tp_sort=None,
+                 row_sort=None):
         self.key = key          # static: ((k, has_w) per tp input)
         self.tp_ids = tp_ids    # per group [world, B, f_g, k_g] int32
         self.tp_w = tp_w        # per group [world, B, f_g, k_g] f32 or None
         self.row_ids = row_ids  # per row input [world, B, k] int32 (sentinel)
         self.row_w = row_w      # per row input [world, B, k] f32
+        self.tp_sort = tp_sort    # per group GroupSort([world, N]...) | None
+        self.row_sort = row_sort  # per row input GroupSort | None
 
     def tree_flatten(self):
-        return ((self.tp_ids, self.tp_w, self.row_ids, self.row_w), self.key)
+        return ((self.tp_ids, self.tp_w, self.row_ids, self.row_w,
+                 self.tp_sort, self.row_sort), self.key)
 
     @classmethod
     def tree_unflatten(cls, key, children):
@@ -194,6 +212,38 @@ def _ragged_exchange_op(operand, output, in_off, send_sz, out_off, recv_sz,
                        operand.shape[0] - 1)
     gathered = ops[s_idx, src_row]
     return jnp.where(valid[:, None], gathered, output)
+
+
+# (backend, world_size) -> bool: did the 'native' (compute_on jit) host
+# apply mode compile on this backend? Probed at most ONCE per process
+# (VERDICT r5 weak #3): every further layer instance / bucket / optimizer
+# reuses the verdict instead of re-compiling the known-failing program and
+# re-spewing XLA's RET_CHECK stack trace to stderr.
+_HOST_NATIVE_VERDICT: dict = {}
+
+
+@contextlib.contextmanager
+def _capture_fd2(out: dict):
+    """Capture OS-level stderr (fd 2) for the duration of the block into
+    ``out['data']`` — XLA's C++ status_macros LOG(ERROR) bypasses
+    sys.stderr, so a Python-level redirect cannot catch it. The window is
+    kept to a single probe call; callers replay the bytes when the error
+    is unexpected so no diagnostics are ever lost."""
+    import sys
+    import tempfile
+    sys.stderr.flush()
+    saved = os.dup(2)
+    cap = tempfile.TemporaryFile(mode="w+b")
+    os.dup2(cap.fileno(), 2)
+    try:
+        yield
+    finally:
+        sys.stderr.flush()
+        os.dup2(saved, 2)
+        os.close(saved)
+        cap.seek(0)
+        out["data"] = cap.read()
+        cap.close()
 
 
 _TILED_INTERPRET_WARNED = [False]
@@ -401,6 +451,11 @@ class DistributedEmbedding:
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype is not None else None)
         self._groups_cache: dict = {}
+        # sort folding (ISSUE 2): (optimizer_kind, dedup_strategy) spec set
+        # by residual_sort_scope — when active, tapped forwards produce
+        # per-group GroupSort residuals (see TapResiduals). None = off, the
+        # strictly-additive default for every non-tapped path.
+        self._residual_sort_spec = None
         # serving hook (see offload_lookup_scope): replaces the host-side
         # offloaded-bucket lookup in tapless forwards — the HBM hot-row
         # cache in `serving/` plugs in here
@@ -714,9 +769,102 @@ class DistributedEmbedding:
                 "ratio": (ex_tot / true_tot) if true_tot else 1.0,
                 "exchange_paths": dict(self._exchange_path_taken)}
 
+    def residual_sort_scope(self, spec):
+        """Scope the sort-folding spec over forwards traced inside it.
+
+        ``spec = (optimizer_kind, dedup_strategy)`` — e.g. ("adagrad",
+        "sort") — tells tapped forwards (``return_residuals=True``) to
+        produce per-group/per-row-input `GroupSort` residual artifacts
+        wherever `sparse_update`'s dispatch (mirrored statically by
+        `sparse_update.update_consumes_sort`) or the tiled forward gather
+        will consume them; ``None`` disables. `make_sparse_train_step`
+        wraps its loss+grad region in this scope, so the production train
+        step sorts each exchange group's ids exactly once (ISSUE 2). The
+        scope is trace-time state on this layer instance — like
+        `offload_lookup_scope`, re-entrant but not thread-safe."""
+
+        @contextlib.contextmanager
+        def scope():
+            prev = self._residual_sort_spec
+            self._residual_sort_spec = spec
+            try:
+                yield self
+            finally:
+                self._residual_sort_spec = prev
+        return scope()
+
+    def _fwd_tiled_active(self, bucket, k: int) -> bool:
+        """Will `_group_lookup` take the tiled Pallas gather for this
+        (bucket, hotness)? Mirrors its dispatch statically (trace-safe)."""
+        path = sparse_update_ops.measured_default("DET_LOOKUP_PATH", "auto")
+        if path != "tiled" or not self.use_custom_kernel:
+            return False
+        if bucket.combiner is None and k != 1:
+            return False       # flatten path; no tiled gather
+        return sparse_update_ops.tiled_fwd_ok_static()
+
+    def _sort_plan(self, groups, spec) -> List[Optional[str]]:
+        """Per exchange group: None (no artifact), "plain" (sid/perm/
+        seg_start for the sparse update) or "inv" (+ inverse permutation,
+        consumed by the tiled forward gather's unpermute). Buckets whose
+        update concatenates several groups keep None — a per-group sort
+        cannot serve the concatenated dedup, and applying the optimizer
+        per group instead would change adagrad/adam numerics."""
+        if spec is None:
+            return [None] * len(groups)
+        opt_kind, strategy = spec
+        per_bucket: dict = {}
+        for grp in groups:
+            per_bucket[grp.bucket] = per_bucket.get(grp.bucket, 0) + 1
+        plan: List[Optional[str]] = []
+        for grp in groups:
+            bucket = self.plan.tp_buckets[grp.bucket]
+            if bucket.offload and self._offload_enabled:
+                plan.append(None)    # host apply path keeps its own dedup
+                continue
+            fwd_inv = self._fwd_tiled_active(bucket, grp.k)
+            upd = (per_bucket[grp.bucket] == 1
+                   and sparse_update_ops.update_consumes_sort(
+                       opt_kind, strategy, max(bucket.rows_max, 1),
+                       bucket.width))
+            plan.append("inv" if fwd_inv else ("plain" if upd else None))
+        return plan
+
+    def _row_sort_plan(self, spec) -> List[Optional[str]]:
+        """Per row-sliced input: "plain" when its table's update will
+        consume the artifact (single-input tables only — shared tables
+        concatenate, see `_sort_plan`)."""
+        n = len(self.strategy.input_groups[2])
+        if spec is None:
+            return [None] * n
+        opt_kind, strategy = spec
+        counts: dict = {}
+        for j in range(n):
+            t = self.strategy.map_groups[2][j]
+            counts[t] = counts.get(t, 0) + 1
+        plan: List[Optional[str]] = []
+        for j in range(n):
+            t = self.strategy.map_groups[2][j]
+            rt = self.plan.row_tables[t]
+            ok = (counts[t] == 1
+                  and sparse_update_ops.update_consumes_sort(
+                      opt_kind, strategy, max(rt.rows_max, 1), rt.width))
+            plan.append("plain" if ok else None)
+        return plan
+
+    @staticmethod
+    def _stack_sort(sort_g: Optional[GroupSort]) -> Optional[GroupSort]:
+        """Add the leading per-device axis residual arrays carry."""
+        if sort_g is None:
+            return None
+        return GroupSort(
+            sort_g.sid[None], sort_g.perm[None], sort_g.seg_start[None],
+            None if sort_g.inv is None else sort_g.inv[None])
+
     def _group_lookup(self, table: jax.Array, ids: jax.Array,
                       weights: Optional[jax.Array],
-                      combiner: Optional[str]) -> jax.Array:
+                      combiner: Optional[str],
+                      presorted: Optional[GroupSort] = None) -> jax.Array:
         """Local fused-bucket lookup + combine: ids [B, f, k] -> [B, f, wf].
 
         Path selection (overridable via DET_LOOKUP_PATH=auto|xla|pallas for
@@ -726,6 +874,11 @@ class DistributedEmbedding:
         'pallas' for one-hot gathers as well; 'xla' forces take + reduce,
         which XLA fuses. (Offloaded buckets never reach here — their lookups
         run host-side in `_host_group_exchange`.)
+
+        `presorted`: a GroupSort of this group's flattened ids (the tapped
+        forward's residual artifact). Only the tiled gather consumes it
+        (and only when it carries `inv`) — the sort + inverse-permute it
+        would otherwise compute itself fold onto the residual sort.
         """
         b_sz, f, k = ids.shape
         path = sparse_update_ops.measured_default("DET_LOOKUP_PATH", "auto")
@@ -747,9 +900,12 @@ class DistributedEmbedding:
             if sparse_update.tiled_kernels_ok(table):
                 w = (weights if weights is not None
                      else jnp.ones((b_sz, f, k), jnp.float32))
+                ps = None
+                if presorted is not None and presorted.inv is not None:
+                    ps = (presorted.sid, presorted.perm, presorted.inv)
                 out = pallas_tiled.tiled_embedding_lookup(
                     table, ids.reshape(b_sz * f, k), w.reshape(b_sz * f, k),
-                    combiner)
+                    combiner, presorted=ps)
                 return self._cast(out.reshape(b_sz, f, out.shape[-1]))
         want_pallas = (self.use_custom_kernel
                        and pallas_lookup.is_tpu_backend()
@@ -791,7 +947,8 @@ class DistributedEmbedding:
 
     def _forward_local(self, dp_params, tp_params, row_params,
                        dp_in, group_ids, group_w, row_in, groups,
-                       taps=None, want_res=False):
+                       taps=None, want_res=False, sort_plan=None,
+                       row_sort_plan=None):
         """The per-device forward (shard_map body when world > 1).
 
         Args:
@@ -805,6 +962,9 @@ class DistributedEmbedding:
             gradients `sparse_update` consumes (no dense table grads).
           want_res: also return TapResiduals arrays (post-exchange ids +
             effective weights).
+          sort_plan / row_sort_plan: static per-group / per-row-input sort
+            production plan (see `_sort_plan`) — which GroupSort residuals
+            to build, and whether the tiled forward consumes them.
 
         Returns (dp_outs, ex_list, row_outs, off_ids, off_w, res):
           dp_outs: [B_l, w] (or [B_l, K, w]) per dp input
@@ -813,8 +973,8 @@ class DistributedEmbedding:
           row_outs: [B_l, ...] partial sums scattered over batch.
           off_ids / off_w: per group the exchanged ids / effective weights
             ([1, ...]-stacked) for offloaded groups, None elsewhere.
-          res: (tp_ids, tp_w, row_ids, row_w) lists ([1, ...]-stacked) or
-            None when want_res is False.
+          res: (tp_ids, tp_w, row_ids, row_w, tp_sort, row_sort) lists
+            ([1, ...]-stacked) or None when want_res is False.
         """
         world = self.world_size
         strat = self.strategy
@@ -862,6 +1022,7 @@ class DistributedEmbedding:
         off_w: List[Optional[jax.Array]] = []
         tp_res_ids: List[jax.Array] = []
         tp_res_w: List[Optional[jax.Array]] = []
+        tp_res_sort: List[Optional[GroupSort]] = []
         for g, grp in enumerate(groups):
             ids = group_ids[g]                               # [B_l, n_g, k]
             blocal = ids.shape[0]
@@ -875,6 +1036,15 @@ class DistributedEmbedding:
             ids_x = ids_x + offs[None, :, None].astype(ids_x.dtype)
             bucket = self.plan.tp_buckets[grp.bucket]
             offloaded = bucket.offload and self._offload_enabled
+            # sort folding: ONE canonical sort of this group's exchanged id
+            # stream, consumed by the tiled forward gather below (when the
+            # plan says "inv") and by the sparse update via the residuals
+            sort_g = None
+            if (want_res and sort_plan is not None and sort_plan[g]
+                    and not offloaded):
+                sort_g = canonical_id_sort(
+                    ids_x, max(bucket.rows_max, 1),
+                    want_inv=(sort_plan[g] == "inv"))
             if offloaded:
                 # id exchange happens on-device (above); the lookup itself
                 # runs host-side outside the shard_map (reference /CPU:0
@@ -888,18 +1058,22 @@ class DistributedEmbedding:
                 off_w.append(None)
                 out = self._tp_group_out(
                     tp_params, grp, ids_x, w_x,
-                    None if taps is None else taps["tp"][g])
+                    None if taps is None else taps["tp"][g],
+                    presorted=sort_g)
                 ex_list.append(self._tp_bucket_exchange(out))
             if want_res:
                 eff_w, _ = _effective_weights(w_x, grp.k, bucket.combiner)
                 tp_res_ids.append(ids_x[None].astype(jnp.int32))
                 tp_res_w.append(None if eff_w is None else eff_w[None])
+                tp_res_sort.append(self._stack_sort(sort_g))
 
         # ---- row-sliced tables: all_gather ids, masked lookup, psum_scatter
         row_outs, row_res = self._row_slice_local(
             row_params, row_in,
-            None if taps is None else taps["row"], want_res)
-        res = ((tp_res_ids, tp_res_w) + row_res) if want_res else None
+            None if taps is None else taps["row"], want_res,
+            sort_plan=row_sort_plan)
+        res = ((tp_res_ids, tp_res_w) + row_res[:2]
+               + (tp_res_sort, row_res[2])) if want_res else None
         return dp_outs, ex_list, row_outs, off_ids, off_w, res
 
     def _use_ragged_exchange(self, grp, world: int) -> bool:
@@ -999,7 +1173,7 @@ class DistributedEmbedding:
 
         return exchange(ids), None if w is None else exchange(w)
 
-    def _tp_group_out(self, tp_params, grp, ids_x, w_x, tap):
+    def _tp_group_out(self, tp_params, grp, ids_x, w_x, tap, presorted=None):
         """One exchange group's local bucket output [B, f, w_out], via the
         explicit weighted-sum form (so tapped and untapped paths share
         numerics), plus the optional tap perturbation."""
@@ -1007,7 +1181,7 @@ class DistributedEmbedding:
         eff_w, scale = _effective_weights(w_x, grp.k, bucket.combiner)
         out = self._group_lookup(
             tp_params[grp.bucket][0], ids_x, eff_w,
-            None if bucket.combiner is None else "sum")
+            None if bucket.combiner is None else "sum", presorted=presorted)
         if scale != 1.0:
             out = out * jnp.asarray(scale, out.dtype)
         if tap is not None:
@@ -1133,12 +1307,13 @@ class DistributedEmbedding:
         return out[None]
 
     def _row_slice_local(self, row_params, row_in, row_taps=None,
-                         want_res=False):
+                         want_res=False, sort_plan=None):
         world = self.world_size
         strat = self.strategy
         row_outs = []
         res_ids: List[jax.Array] = []
         res_w: List[jax.Array] = []
+        res_sort: List[Optional[GroupSort]] = []
         for j, (ids, weights) in enumerate(row_in):
             t = strat.map_groups[2][j]
             rt = self.plan.row_tables[t]
@@ -1177,10 +1352,14 @@ class DistributedEmbedding:
                 sent = jnp.where(valid, local, rt.rows_max).astype(jnp.int32)
                 res_ids.append(sent[None])
                 res_w.append((w_full * scale)[None])
-        return row_outs, (res_ids, res_w)
+                sort_j = None
+                if sort_plan is not None and sort_plan[j]:
+                    sort_j = canonical_id_sort(sent, max(rt.rows_max, 1))
+                res_sort.append(self._stack_sort(sort_j))
+        return row_outs, (res_ids, res_w, res_sort)
 
     def apply(self, params: dict, inputs: Sequence, taps=None,
-              return_residuals: bool = False):
+              return_residuals: bool = False, residual_sort=None):
         """Forward pass with data-parallel input.
 
         Args:
@@ -1194,6 +1373,11 @@ class DistributedEmbedding:
             IndexedSlices backward (embedding_lookup_ops.py:105-122), with
             no dense [V, w] gradient ever materialized.
           return_residuals: also return the TapResiduals for `sparse_update`.
+          residual_sort: sort-folding control. None (default) defers to the
+            ambient `residual_sort_scope` (off unless scoped — non-tapped
+            and host-offload paths keep their exact pre-fold behavior);
+            False forces off; an (optimizer_kind, strategy) tuple forces
+            the spec. Only consulted when return_residuals is True.
 
         Returns:
           One [B, width] array per input (or [B, k, width] for combiner=None
@@ -1203,6 +1387,10 @@ class DistributedEmbedding:
         if not self.dp_input:
             raise ValueError("This layer was built with dp_input=False; "
                              "use apply_mp() instead")
+        if residual_sort is None:
+            sort_spec = self._residual_sort_spec
+        else:
+            sort_spec = None if residual_sort is False else residual_sort
         prepped = self._prepare_inputs(inputs)
         strat = self.strategy
         world = self.world_size
@@ -1239,6 +1427,10 @@ class DistributedEmbedding:
         row_in = [(p.ids, p.weights) for p in row_prep]
 
         want_res = bool(return_residuals)
+        sort_plan = (self._sort_plan(groups, sort_spec) if want_res
+                     else [None] * len(groups))
+        row_sort_plan = (self._row_sort_plan(sort_spec) if want_res
+                         else [None] * len(row_in))
         offloaded_groups = [
             g for g, grp in enumerate(groups)
             if self.plan.tp_buckets[grp.bucket].offload
@@ -1282,11 +1474,16 @@ class DistributedEmbedding:
                 [None if g is None else P(self.axis)
                  for g in group_w],
                 [P(self.axis)] * len(row_in),
-                [P(self.axis)] * len(row_in)) if want_res else None,)
+                [P(self.axis)] * len(row_in),
+                # GroupSort subtrees take P(axis) as a pytree-prefix spec
+                [None if p is None else P(self.axis) for p in sort_plan],
+                [None if p is None else P(self.axis)
+                 for p in row_sort_plan]) if want_res else None,)
             dp_outs, ex_list, row_outs, off_ids, off_w, res = compat.shard_map(
                 lambda d, t, r, di, gi, gw, ri, tp: self._forward_local(
                     d, t, r, di, gi, gw, ri, groups, taps=tp,
-                    want_res=want_res),
+                    want_res=want_res, sort_plan=sort_plan,
+                    row_sort_plan=row_sort_plan),
                 mesh=self.mesh, in_specs=in_specs,
                 out_specs=out_specs + res_specs,
                 check_vma=False,
@@ -1296,7 +1493,8 @@ class DistributedEmbedding:
                 self._forward_local(
                     params["dp"], params["tp"], params["row"],
                     dp_in, group_ids, group_w, row_in, groups,
-                    taps=inner_taps, want_res=want_res))
+                    taps=inner_taps, want_res=want_res,
+                    sort_plan=sort_plan, row_sort_plan=row_sort_plan))
 
         # offloaded buckets: host-side lookup + GSPMD exchange (or the
         # scoped serving override — see offload_lookup_scope)
@@ -1327,7 +1525,8 @@ class DistributedEmbedding:
         outputs = [outputs[idx] for idx in strat.rev_group_ids]
         if want_res:
             key = tuple((p.k, p.weights is not None) for p in tp_prep)
-            return outputs, TapResiduals(key, res[0], res[1], res[2], res[3])
+            return outputs, TapResiduals(key, res[0], res[1], res[2], res[3],
+                                         res[4], res[5])
         return outputs
 
     def _assemble_tp_outputs(self, ex_list, tp_preps, batch, groups,
@@ -1359,7 +1558,7 @@ class DistributedEmbedding:
         return tp_final
 
     def apply_mp(self, params: dict, inputs, taps=None,
-                 return_residuals: bool = False):
+                 return_residuals: bool = False, residual_sort=None):
         """Forward pass with model-parallel input (dp_input=False).
 
         The reference mp-input contract (:729-731, :846-851): each rank
@@ -1547,15 +1746,27 @@ class DistributedEmbedding:
                                  for g, t in enumerate(taps["tp"])],
                           "row": taps.get("row", [])}
 
+        if residual_sort is None:
+            sort_spec = self._residual_sort_spec
+        else:
+            sort_spec = None if residual_sort is False else residual_sort
+        sort_plan = (self._sort_plan(groups, sort_spec) if return_residuals
+                     else [None] * len(groups))
+
         def body(tp_params, group_ids, group_w, taps_l):
             ex_list, off_ids, off_w = [], [], []
-            res_ids, res_w = [], []
+            res_ids, res_w, res_sort = [], [], []
             for g, grp in enumerate(groups):
                 ids_l = group_ids[g][0]                         # [B, f, k]
                 offs = self._device_const(grp.offs)
                 ids_l = ids_l + offs[None, :, None].astype(ids_l.dtype)
                 w_l = group_w[g][0] if group_w[g] is not None else None
                 bucket = self.plan.tp_buckets[grp.bucket]
+                sort_g = None
+                if return_residuals and sort_plan[g]:
+                    sort_g = canonical_id_sort(
+                        ids_l, max(bucket.rows_max, 1),
+                        want_inv=(sort_plan[g] == "inv"))
                 if g in offloaded_groups:
                     eff_w, _ = _effective_weights(w_l, grp.k, bucket.combiner)
                     off_ids.append(ids_l[None].astype(jnp.int32))
@@ -1566,13 +1777,16 @@ class DistributedEmbedding:
                     off_w.append(None)
                     out = self._tp_group_out(
                         tp_params, grp, ids_l, w_l,
-                        None if taps_l is None else taps_l["tp"][g])
+                        None if taps_l is None else taps_l["tp"][g],
+                        presorted=sort_g)
                     ex_list.append(self._tp_bucket_exchange(out))
                 if return_residuals:
                     eff_w, _ = _effective_weights(w_l, grp.k, bucket.combiner)
                     res_ids.append(ids_l[None].astype(jnp.int32))
                     res_w.append(None if eff_w is None else eff_w[None])
-            res = (res_ids, res_w) if return_residuals else None
+                    res_sort.append(self._stack_sort(sort_g))
+            res = ((res_ids, res_w, res_sort) if return_residuals
+                   else None)
             return ex_list, off_ids, off_w, res
 
         if world > 1:
@@ -1586,7 +1800,8 @@ class DistributedEmbedding:
                                    and group_w[g] is not None) else None)
                  for g in range(len(groups))],
                 (([P(self.axis)] * len(groups),
-                  [None if g is None else P(self.axis) for g in group_w])
+                  [None if g is None else P(self.axis) for g in group_w],
+                  [None if p is None else P(self.axis) for p in sort_plan])
                  if return_residuals else None),
             )
             ex_list, off_ids, off_w, res = compat.shard_map(
@@ -1613,7 +1828,8 @@ class DistributedEmbedding:
         outputs = [outputs[idx] for idx in strat.rev_group_ids]
         if return_residuals:
             key = tuple((p.k, p.weights is not None) for p in tp_preps)
-            return outputs, TapResiduals(key, res[0], res[1], [], [])
+            return outputs, TapResiduals(key, res[0], res[1], [], [],
+                                         res[2], [])
         return outputs
 
     # ------------------------------------------------- sparse training path
@@ -1721,13 +1937,24 @@ class DistributedEmbedding:
                                  contrib.reshape(world, -1, wf))
         return SparseRowGrad(ids_x.reshape(-1), contrib.reshape(-1, wf))
 
+    @staticmethod
+    def _unstack_sort(s: Optional[GroupSort]) -> Optional[GroupSort]:
+        """Strip the leading per-device axis of a residual GroupSort."""
+        if s is None:
+            return None
+        return GroupSort(s.sid[0], s.perm[0], s.seg_start[0],
+                         None if s.inv is None else s.inv[0])
+
     def _sparse_update_body(self, tp_params, row_params, tp_states,
                             row_states, tp_g, row_g, res_tp_ids, res_tp_w,
-                            res_row_ids, res_row_w, groups, opt,
-                            dev_buckets):
+                            res_row_ids, res_row_w, res_tp_sort,
+                            res_row_sort, groups, opt, dev_buckets):
         """Per-device sparse updates (stacked [1, rows, w] shards in/out).
         tp_params/tp_states hold only the non-offloaded buckets, in
-        dev_buckets order."""
+        dev_buckets order. res_tp_sort / res_row_sort carry the forward's
+        per-group sort artifacts (sort folding) — consumed only where a
+        bucket's grad comes from a single group, so the folded update is
+        bit-identical to the fresh-sort one."""
 
         def split_state(state):
             return tuple(x[0] if getattr(x, "ndim", 0) == 3 else x
@@ -1743,16 +1970,23 @@ class DistributedEmbedding:
 
         new_tp, new_tp_s = [], []
         for pos, b in enumerate(dev_buckets):
+            gs = bucket_groups.get(b, [])
             grads = [self._group_contrib(g, groups[g], res_tp_ids, res_tp_w,
                                          tp_g, stacked=False)
-                     for g in bucket_groups.get(b, [])]
+                     for g in gs]
             if not grads:
                 new_tp.append(tp_params[pos])
                 new_tp_s.append(tp_states[pos])
                 continue
+            sort_b = (self._unstack_sort(res_tp_sort[gs[0]])
+                      if len(gs) == 1 else None)
+            # kwarg only when an artifact exists: pre-fold user-built
+            # SparseOptimizers with 3-arg update callables keep working
+            # whenever no fold is active
+            kw = {} if sort_b is None else {"presorted": sort_b}
             t_new, s_new = opt.update(tp_params[pos][0],
                                       split_state(tp_states[pos]),
-                                      concat_grads(grads))
+                                      concat_grads(grads), **kw)
             new_tp.append(t_new[None])
             new_tp_s.append(stack_state(s_new))
 
@@ -1774,9 +2008,12 @@ class DistributedEmbedding:
                 contrib = gk.astype(jnp.float32) * w[..., None]
                 grads.append(SparseRowGrad(
                     ids.reshape(-1), contrib.reshape(-1, rt.width)))
+            sort_t = (self._unstack_sort(res_row_sort[js[0]])
+                      if len(js) == 1 else None)
+            kw = {} if sort_t is None else {"presorted": sort_t}
             t_new, s_new = opt.update(row_params[t][0],
                                       split_state(row_states[t]),
-                                      concat_grads(grads))
+                                      concat_grads(grads), **kw)
             new_row[t] = t_new[None]
             new_row_s[t] = stack_state(s_new)
         return new_tp, new_row, new_tp_s, new_row_s
@@ -1853,11 +2090,15 @@ class DistributedEmbedding:
         groups, _ = self._exchange_groups_for_key(residuals.key)
         tp_dev = [params["tp"][b] for b in dev_buckets]
         tp_dev_s = [opt_states["tp"][b] for b in dev_buckets]
+        # sort-folding artifacts (absent on pre-fold / residual_sort-off
+        # residual pytrees: normalize to per-entry None)
+        tp_sort = residuals.tp_sort or [None] * len(residuals.tp_ids)
+        row_sort = residuals.row_sort or [None] * len(residuals.row_ids)
 
         args = (tp_dev, params["row"], tp_dev_s,
                 opt_states["row"], tap_grads["tp"], tap_grads["row"],
                 residuals.tp_ids, residuals.tp_w, residuals.row_ids,
-                residuals.row_w)
+                residuals.row_w, tp_sort, row_sort)
         if self.world_size > 1:
             sspec = lambda tree: jax.tree.map(self._state_spec, tree)
             pspec = lambda tree, s: jax.tree.map(lambda _: s, tree)
@@ -1869,7 +2110,9 @@ class DistributedEmbedding:
                         pspec(residuals.tp_ids, P(self.axis)),
                         pspec(residuals.tp_w, P(self.axis)),
                         pspec(residuals.row_ids, P(self.axis)),
-                        pspec(residuals.row_w, P(self.axis)))
+                        pspec(residuals.row_w, P(self.axis)),
+                        pspec(tp_sort, P(self.axis)),
+                        pspec(row_sort, P(self.axis)))
             out_specs = (pspec(tp_dev, P(self.axis)),
                          pspec(params["row"], P(self.axis)),
                          sspec(tp_dev_s), sspec(opt_states["row"]))
@@ -2033,47 +2276,90 @@ class DistributedEmbedding:
                 fn = run_pershard
             else:
                 fallback = run_pershard if f32_ok else run_roundtrip
+                fb_mode = ("pershard" if fallback is run_pershard
+                           else "roundtrip")
+                # the native-mode verdict is a property of (backend,
+                # world_size), not of this layer/bucket/optimizer: consult
+                # the process-wide cache before compiling the probe again
+                # (VERDICT r5 weak #3 — re-probing spewed one XLA RET_CHECK
+                # stack trace per offloaded init)
+                vkey = (jax.default_backend(), self.world_size)
+                verdict = _HOST_NATIVE_VERDICT.get(vkey)
+                if verdict is True:
+                    self._host_fn_cache[mode_key] = "native"
+                    fn = native
+                elif verdict is False:
+                    if fb_mode == "roundtrip":
+                        # the cached verdict must not silence the per-step
+                        # perf-cliff signal the probe path emits
+                        import warnings
+                        warnings.warn(
+                            "host-memory sparse apply unsupported on this "
+                            "backend (cached verdict) and the bucket is "
+                            "not f32; falling back to a device round-trip "
+                            f"per step for offloaded bucket {b}",
+                            RuntimeWarning, stacklevel=2)
+                    self._host_fn_cache[mode_key] = fb_mode
+                    fn = fallback
 
                 def probe(table_h, state_h, rep, sums, valid, lr_a):
-                    try:
-                        out = native(table_h, state_h, rep, sums, valid,
-                                     lr_a)
+                    err, cap = None, {}
+                    # fd-level capture: the partitioner RET_CHECK is
+                    # LOG(ERROR)'d from C++ before the Python exception
+                    # exists, so sys.stderr redirection cannot catch it
+                    with _capture_fd2(cap):
+                        try:
+                            out = native(table_h, state_h, rep, sums,
+                                         valid, lr_a)
+                        except jax.errors.JaxRuntimeError as e:
+                            err = e
+                    if err is None:
+                        _HOST_NATIVE_VERDICT[vkey] = True
+                        if cap.get("data"):
+                            os.write(2, cap["data"])   # replay non-error spew
                         self._host_fn_cache[mode_key] = "native"
                         self._host_fn_cache[key] = native
                         return out
-                    except jax.errors.JaxRuntimeError as e:
-                        # only the known backend gaps fall back: SPMD
-                        # partitioners that cannot place host-memory
-                        # outputs (two phrasings depending on whether the
-                        # offender is an array or a scalar placement
-                        # annotation) and backends with no host-placement
-                        # custom-call at all (XLA:CPU single-device).
-                        if ("cannot be replicated" not in str(e)
-                                and "Side-effect HLO must have sharding"
-                                not in str(e)
-                                and "annotate_device_placement" not in
-                                str(e)):
-                            raise
-                        if fallback is run_roundtrip:
-                            import warnings
-                            warnings.warn(
-                                "host-memory sparse apply unsupported on "
-                                "this backend and the bucket is not f32; "
-                                "falling back to a device round-trip per "
-                                f"step for offloaded bucket {b}",
-                                RuntimeWarning, stacklevel=2)
-                            self._host_fn_cache[mode_key] = "roundtrip"
-                        else:
-                            logging.getLogger(__name__).info(
-                                "offloaded bucket %d: backend cannot "
-                                "partition host-placement outputs; using "
-                                "the XLA-free per-shard host apply "
-                                "(row-only wire traffic)", b)
-                            self._host_fn_cache[mode_key] = "pershard"
-                        self._host_fn_cache[key] = fallback
-                        return fallback(table_h, state_h, rep, sums,
-                                        valid, lr_a)
-                fn = probe
+                    # only the known backend gaps fall back: SPMD
+                    # partitioners that cannot place host-memory outputs
+                    # (two phrasings depending on whether the offender is
+                    # an array or a scalar placement annotation) and
+                    # backends with no host-placement custom-call at all
+                    # (XLA:CPU single-device). Anything else replays the
+                    # captured spew and re-raises — never hide an
+                    # unexpected failure.
+                    if ("cannot be replicated" not in str(err)
+                            and "Side-effect HLO must have sharding"
+                            not in str(err)
+                            and "annotate_device_placement" not in
+                            str(err)):
+                        if cap.get("data"):
+                            os.write(2, cap["data"])
+                        raise err
+                    _HOST_NATIVE_VERDICT[vkey] = False
+                    first_line = str(err).splitlines()[0][:160]
+                    if fallback is run_roundtrip:
+                        import warnings
+                        warnings.warn(
+                            "host-memory sparse apply unsupported on "
+                            "this backend and the bucket is not f32; "
+                            "falling back to a device round-trip per "
+                            f"step for offloaded bucket {b}",
+                            RuntimeWarning, stacklevel=2)
+                        self._host_fn_cache[mode_key] = "roundtrip"
+                    else:
+                        logging.getLogger(__name__).info(
+                            "offloaded bucket %d: backend cannot partition "
+                            "host-placement outputs (%s); using the "
+                            "XLA-free per-shard host apply (row-only wire "
+                            "traffic). Probe spew suppressed; verdict "
+                            "cached for %s.", b, first_line, vkey)
+                        self._host_fn_cache[mode_key] = "pershard"
+                    self._host_fn_cache[key] = fallback
+                    return fallback(table_h, state_h, rep, sums,
+                                    valid, lr_a)
+                if verdict is None:
+                    fn = probe
             self._host_fn_cache.setdefault(key, fn)
         return fn(table_h, state_h, rep, sums, valid,
                   jnp.asarray(lr_in, jnp.float32))
@@ -2177,12 +2463,14 @@ class DistributedEmbedding:
         return out
 
     def __call__(self, params, inputs, taps=None,
-                 return_residuals: bool = False):
+                 return_residuals: bool = False, residual_sort=None):
         if self.dp_input:
             return self.apply(params, inputs, taps=taps,
-                              return_residuals=return_residuals)
+                              return_residuals=return_residuals,
+                              residual_sort=residual_sort)
         return self.apply_mp(params, inputs, taps=taps,
-                             return_residuals=return_residuals)
+                             return_residuals=return_residuals,
+                             residual_sort=residual_sort)
 
     # --------------------------------------------------------- weights I/O
     def _shard_host(self, arr: jax.Array, rank: int,

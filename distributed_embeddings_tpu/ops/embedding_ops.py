@@ -23,6 +23,7 @@ from typing import NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 class RaggedIds(NamedTuple):
@@ -62,6 +63,50 @@ class SparseIds(NamedTuple):
 
 
 IdsLike = Union[jax.Array, RaggedIds, SparseIds]
+
+
+class GroupSort(NamedTuple):
+    """Sort artifacts of one id stream, shared by a lookup and its sparse
+    update (the 'one-sort production step': the reference's CUDA backward
+    reuses the forward kernel over already-sorted ids,
+    embedding_lookup_kernels.cu:706-773 — this is the artifact that makes
+    the same reuse legal here).
+
+    The sort key is CANONICAL: valid ids keep their value, out-of-bounds
+    ids (negative or >= rows) key to exactly `rows` — byte-identical to
+    both `dedup_sum`'s sentinel keys and `pallas_tiled._sort_ids`'s keys,
+    so one `lax.sort_key_val` serves the dedup aggregation, the tiled
+    update kernels, and (clamped) the tiled forward gather.
+
+      sid:       [N] int32 ascending canonical keys (OOB slots == rows).
+      perm:      [N] int32, ids.reshape(-1)[perm[n]] has key sid[n].
+      seg_start: [N] bool, True where sid starts a new segment.
+      inv:       [N] int32 inverse permutation (inv[perm[n]] == n), or None
+                 when no consumer needs original-order restoration. Costs a
+                 second sort op — only produced when the tiled forward
+                 gather's unpermute consumes it.
+    """
+
+    sid: jax.Array
+    perm: jax.Array
+    seg_start: jax.Array
+    inv: Optional[jax.Array] = None
+
+
+def canonical_id_sort(ids: jax.Array, rows: int,
+                      want_inv: bool = False) -> GroupSort:
+    """One stable sort of a (flattened) id stream under the canonical key
+    (see GroupSort). `rows` must equal the consuming table shard's
+    shape[0] — the same sentinel `dedup_sum` would use — or the folded and
+    unfolded update paths stop being bit-exact."""
+    flat = ids.reshape(-1).astype(jnp.int32)
+    keys = jnp.where((flat >= 0) & (flat < rows), flat, jnp.int32(rows))
+    iota = lax.iota(jnp.int32, flat.shape[0])
+    sid, perm = lax.sort_key_val(keys, iota)
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    inv = lax.sort_key_val(perm, iota)[1] if want_inv else None
+    return GroupSort(sid, perm, seg_start, inv)
 
 
 def read_var_no_copy(params: jax.Array) -> jax.Array:

@@ -562,18 +562,24 @@ def _tiled_lookup_impl(params, ids, weights, interpret, presorted=None):
     return jnp.einsum("bk,bkw->bw", weights.astype(jnp.float32), rows)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _tiled_lookup(params, ids, weights, interpret):
-    return _tiled_lookup_impl(params, ids, weights, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _tiled_lookup(params, ids, weights, presorted, interpret):
+    return _tiled_lookup_impl(params, ids, weights, interpret,
+                              presorted=presorted)
 
 
-def _tiled_lookup_fwd(params, ids, weights, interpret):
+def _tiled_lookup_fwd(params, ids, weights, presorted, interpret):
     # sort once: the backward reuses (sid, perm, inv) for BOTH its
     # aggregation and its dweights gather (the id stream is identical, and
-    # XLA CSE does not merge fwd/bwd sorts — measured round 5)
-    sid, _, perm = _sort_ids(ids.reshape(-1), None, params.shape[0])
-    iota = lax.iota(jnp.int32, perm.shape[0])
-    inv = lax.sort_key_val(perm, iota)[1]
+    # XLA CSE does not merge fwd/bwd sorts — measured round 5). A caller-
+    # provided `presorted` (the tapped path's TapResiduals artifact) folds
+    # even the forward's own sort away.
+    if presorted is None:
+        sid, _, perm = _sort_ids(ids.reshape(-1), None, params.shape[0])
+        iota = lax.iota(jnp.int32, perm.shape[0])
+        inv = lax.sort_key_val(perm, iota)[1]
+    else:
+        sid, perm, inv = presorted
     return (_tiled_lookup_impl(params, ids, weights, interpret,
                                presorted=(sid, perm, inv)),
             (params, ids, weights, sid, perm, inv))
@@ -598,7 +604,7 @@ def _tiled_lookup_bwd(interpret, res, g):
                         presorted=(sid, perm, inv)).reshape(
         ids.shape[0], ids.shape[1], -1).astype(g.dtype)
     dweights = jnp.einsum("bkw,bw->bk", rows, g).astype(weights.dtype)
-    return dtable, None, dweights
+    return dtable, None, dweights, None
 
 
 _tiled_lookup.defvjp(_tiled_lookup_fwd, _tiled_lookup_bwd)
@@ -607,12 +613,20 @@ _tiled_lookup.defvjp(_tiled_lookup_fwd, _tiled_lookup_bwd)
 def tiled_embedding_lookup(params: jax.Array, ids: jax.Array,
                            weights: Optional[jax.Array] = None,
                            combiner: str = "sum",
-                           interpret: Optional[bool] = None) -> jax.Array:
+                           interpret: Optional[bool] = None,
+                           presorted=None) -> jax.Array:
     """Padded multi-hot lookup over the tiled gather: [V,W] table, [B,K]
     ids -> [B,W]. Same contract as pallas_lookup.fused_embedding_lookup
     (weights carry 0.0 in padded slots; mean pre-normalizes; OOB ids
     clamped to match XLA gather semantics). Differentiable in params and
-    weights."""
+    weights.
+
+    `presorted`: optional (sid, perm, inv) of the FLATTENED id stream under
+    the canonical key (embedding_ops.canonical_id_sort) — typically the
+    tapped forward's residual sort. sid is clamped to V-1 here, so positive
+    OOB ids keep their XLA clamp semantics; NEGATIVE ids (already
+    unspecified in the fused-bucket forward) read row V-1 instead of row 0
+    on this path."""
     if combiner not in ("sum", "mean"):
         raise ValueError(f"Unsupported combiner {combiner}")
     if weights is None:
@@ -621,5 +635,8 @@ def tiled_embedding_lookup(params: jax.Array, ids: jax.Array,
         denom = jnp.maximum(jnp.sum(weights, axis=1, keepdims=True), 1.0)
         weights = weights / denom
     ids = jnp.clip(ids, 0, params.shape[0] - 1)
-    return _tiled_lookup(params, ids, weights,
+    if presorted is not None:
+        sid, perm, inv = presorted
+        presorted = (jnp.minimum(sid, params.shape[0] - 1), perm, inv)
+    return _tiled_lookup(params, ids, weights, presorted,
                          interpret).astype(params.dtype)

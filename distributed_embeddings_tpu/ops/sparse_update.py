@@ -58,11 +58,17 @@ def measured_default(knob: str, fallback: str) -> str:
     editing defaults. Env vars always override; the file is consulted ONLY
     on the TPU backend (CPU test equivalence must not silently change when
     a TPU bench has run on the same checkout), and a missing/invalid file
-    (e.g. an installed wheel with no tools/ dir) means `fallback`."""
+    (e.g. an installed wheel with no tools/ dir) means `fallback`.
+    DET_MEASURED_DEFAULTS_CONSULT=1 forces the file read off-TPU — the
+    unattended-window rehearsal's knob (tools/window_rehearsal.py), which
+    must verify on CPU that a written flip actually changes this
+    function's output before the flip machinery runs unattended on
+    hardware."""
     env = os.environ.get(knob)
     if env is not None:
         return env
-    if jax.default_backend() != "tpu":
+    if (jax.default_backend() != "tpu"
+            and os.environ.get("DET_MEASURED_DEFAULTS_CONSULT") != "1"):
         return fallback
     global _MEASURED_DEFAULTS
     if _MEASURED_DEFAULTS is None:
@@ -253,6 +259,17 @@ def _use_tiled(ref_array) -> bool:
     return _TILED_GATE.active(ref_array)
 
 
+def tiled_fwd_ok_static() -> bool:
+    """Trace-time twin of `tiled_kernels_ok` that never triggers an eager
+    prevalidation: off-TPU the kernels run in interpret mode (always ok);
+    on TPU only an already-cached hardware verdict counts (the layer /
+    train-step constructors run `prevalidate_active_impl` eagerly, so by
+    trace time the verdict exists whenever the tiled path is requested)."""
+    if jax.default_backend() != "tpu":
+        return True
+    return bool(_TILED_GATE.verdict)
+
+
 def _tiled_route(strategy: str, ref_array) -> bool:
     """True when the tiled kernels should serve this update: explicit
     strategy='tiled' (validation-gated on TPU, interpret off-TPU) or
@@ -352,7 +369,8 @@ def concat_grads(grads) -> "SparseRowGrad":
         jnp.concatenate([g.contribs for g in grads], axis=0))
 
 
-def dedup_sum(ids: jax.Array, contribs: jax.Array, sentinel: int):
+def dedup_sum(ids: jax.Array, contribs: jax.Array, sentinel: int,
+              presorted=None):
     """Aggregate duplicate row ids: returns (rep_ids [N], sums [N, w]) where
     segment s's id sits at rep_ids[s] with its total in sums[s]; unused slots
     carry rep_ids >= sentinel (dropped by the subsequent scatter).
@@ -362,6 +380,13 @@ def dedup_sum(ids: jax.Array, contribs: jax.Array, sentinel: int):
     formulation would avoid the segment scatter but loses ~N*eps relative
     precision at N in the millions — exactness wins here, matching the
     reference's sort+unique+sum contract, .cu:645-661.)
+
+    `presorted` optionally carries this id stream's sort artifacts (an
+    `embedding_ops.GroupSort` — sid/perm/seg_start under the SAME canonical
+    key with `rows == sentinel`) from an earlier sort, e.g. the tapped
+    forward's (TapResiduals): the dedup then runs zero sort ops and is
+    bit-identical to the fresh-sort path, the analogue of the reference
+    backward reusing forward-sorted ids (.cu:706-773).
 
     rep is STRICTLY INCREASING by construction: real segments carry the
     sorted unique ids (any OOB inputs are pre-collapsed onto `sentinel`,
@@ -375,17 +400,21 @@ def dedup_sum(ids: jax.Array, contribs: jax.Array, sentinel: int):
     """
     n = ids.shape[0]
     iota = lax.iota(jnp.int32, n)
-    # collapse BOTH invalid sides onto the sentinel: a plain min() would let
-    # negative ids through, and JAX scatters treat negative indices as
-    # NumPy-style from-the-end (mode="drop" only drops ids outside [-V, V)),
-    # silently updating the TAIL of the table (ADVICE r3 medium)
-    ids32 = ids.astype(jnp.int32)
-    keys = jnp.where(ids32 < 0, jnp.int32(sentinel),
-                     jnp.minimum(ids32, jnp.int32(sentinel)))
-    sid, perm = lax.sort_key_val(keys, iota)
+    if presorted is not None:
+        sid, perm, is_start = (presorted.sid, presorted.perm,
+                               presorted.seg_start)
+    else:
+        # collapse BOTH invalid sides onto the sentinel: a plain min() would
+        # let negative ids through, and JAX scatters treat negative indices
+        # as NumPy-style from-the-end (mode="drop" only drops ids outside
+        # [-V, V)), silently updating the TAIL of the table (ADVICE r3)
+        ids32 = ids.astype(jnp.int32)
+        keys = jnp.where(ids32 < 0, jnp.int32(sentinel),
+                         jnp.minimum(ids32, jnp.int32(sentinel)))
+        sid, perm = lax.sort_key_val(keys, iota)
+        is_start = jnp.concatenate(
+            [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
     rows = jnp.take(contribs, perm, axis=0)
-    is_start = jnp.concatenate(
-        [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
     if _dedup_impl() == "cumsum":
         return _dedup_sum_cumsum(sid, rows, is_start, sentinel, iota)
     seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1      # exact int prefix
@@ -446,18 +475,34 @@ def _pick(strategy: str, rows: int, width: int) -> str:
     return "dense" if rows * width <= mx else "sort"
 
 
+def _usable_presorted(presorted, grad: SparseRowGrad, rows: int):
+    """The given GroupSort, or None when it cannot serve this grad: the
+    artifact must cover exactly this id stream (same static length). A
+    mismatched artifact (e.g. a per-group sort offered against a
+    multi-group concat) degrades to the fresh-sort path rather than
+    corrupting the update."""
+    if presorted is None or presorted.sid.shape[0] != grad.ids.shape[0]:
+        return None
+    return presorted
+
+
 # ------------------------------------------------------------------ SGD
 def sparse_sgd(table: jax.Array, grad: SparseRowGrad, lr,
-               strategy: str = "auto") -> jax.Array:
+               strategy: str = "auto", presorted=None) -> jax.Array:
     """table[ids] -= lr * contribs. Duplicates need no aggregation (add is
     associative); OOB/padded ids are dropped by the scatter. (The round-3
     DET_SGD_DEDUP aggregate-first variant was removed in round 5: the
     tiled kernel family subsumes its hypothesis — aggregation happens
     in-kernel with no scatter at all — and the knob never earned a
-    hardware number; docs/round5_notes.md 'knob disposition'.)"""
+    hardware number; docs/round5_notes.md 'knob disposition'.)
+    `presorted` (GroupSort) feeds the tiled kernel's sorted stream; the
+    XLA scatter path needs no order and ignores it."""
     if _tiled_route(strategy, table):
         from distributed_embeddings_tpu.ops import pallas_tiled as ptl
-        return ptl.tiled_sgd(table, grad.ids, grad.contribs, lr)
+        ps = _usable_presorted(presorted, grad, table.shape[0])
+        return ptl.tiled_sgd(table, grad.ids, grad.contribs, lr,
+                             presorted=(None if ps is None
+                                        else (ps.sid, ps.perm)))
     # negative ids -> dropped OOB row, not NumPy wraparound (see dedup_sum)
     safe_ids = jnp.where(grad.ids < 0, table.shape[0], grad.ids)
     return table.at[safe_ids].add(
@@ -467,21 +512,27 @@ def sparse_sgd(table: jax.Array, grad: SparseRowGrad, lr,
 
 # -------------------------------------------------------------- Adagrad
 def sparse_adagrad(table: jax.Array, accum: jax.Array, grad: SparseRowGrad,
-                   lr, eps: float = 1e-10, strategy: str = "auto"):
+                   lr, eps: float = 1e-10, strategy: str = "auto",
+                   presorted=None):
     """Row-wise adagrad matching optax.adagrad on the touched rows:
         acc[r]   += (sum of contribs for r)^2
         table[r] -= lr * sum / sqrt(acc[r] + eps)
     Duplicates are aggregated first (the reference's unique-grad contract).
-    Returns (new_table, new_accum).
+    `presorted` (GroupSort over this id stream, rows == table.shape[0])
+    removes the sort from both the tiled kernel and the dedup pass —
+    bit-identical results either way. Returns (new_table, new_accum).
     """
     rows = table.shape[0]
+    ps = _usable_presorted(presorted, grad, rows)
     if _tiled_route(strategy, table):
         # tiled one-hot-matmul kernel: sort + in-kernel aggregation, no
         # dedup pass, no scatter (see ops/pallas_tiled.py). Explicit
         # strategy="tiled" runs in interpret mode off-TPU (tests).
         from distributed_embeddings_tpu.ops import pallas_tiled as ptl
         return ptl.tiled_adagrad(table, accum, grad.ids, grad.contribs,
-                                 lr, eps=eps)
+                                 lr, eps=eps,
+                                 presorted=(None if ps is None
+                                            else (ps.sid, ps.perm)))
     how = _pick(strategy, rows, table.shape[-1])
     if how == "dense":
         g, touched = _dense_sum(grad.ids, grad.contribs, rows)
@@ -489,7 +540,8 @@ def sparse_adagrad(table: jax.Array, accum: jax.Array, grad: SparseRowGrad,
         upd = jnp.where(touched[:, None],
                         -lr * g * lax.rsqrt(acc_new + eps), 0.0)
         return table + upd.astype(table.dtype), acc_new
-    rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows)
+    rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows,
+                          presorted=ps)
     lr_static = _static_float(lr)
     if _use_pallas_scatter(table) and lr_static is not None:
         # fused RMW stream: one pass reads+updates table and accumulator
@@ -518,17 +570,20 @@ def sparse_adagrad(table: jax.Array, accum: jax.Array, grad: SparseRowGrad,
 # ----------------------------------------------------------------- Adam
 def sparse_adam(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
                 grad: SparseRowGrad, lr, b1: float = 0.9, b2: float = 0.999,
-                eps: float = 1e-8, strategy: str = "auto"):
+                eps: float = 1e-8, strategy: str = "auto", presorted=None):
     """Lazy row-wise Adam: moments decay only on touched rows (the standard
     sparse-Adam compromise — identical to dense Adam when every row is
-    touched every step; avoids O(V) work otherwise). Returns
-    (table, mu, nu, count).
+    touched every step; avoids O(V) work otherwise). `presorted`: see
+    sparse_adagrad. Returns (table, mu, nu, count).
     """
     rows = table.shape[0]
+    ps = _usable_presorted(presorted, grad, rows)
     if _tiled_route(strategy, table):
         from distributed_embeddings_tpu.ops import pallas_tiled as ptl
         return ptl.tiled_adam(table, mu, nu, count, grad.ids, grad.contribs,
-                              lr, b1=b1, b2=b2, eps=eps)
+                              lr, b1=b1, b2=b2, eps=eps,
+                              presorted=(None if ps is None
+                                         else (ps.sid, ps.perm)))
     count = count + 1
     c1 = 1.0 - b1 ** count.astype(jnp.float32)
     c2 = 1.0 - b2 ** count.astype(jnp.float32)
@@ -541,7 +596,8 @@ def sparse_adam(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
         upd = jnp.where(t, -lr * (mu_new / c1)
                         / (jnp.sqrt(nu_new / c2) + eps), 0.0)
         return table + upd.astype(table.dtype), mu_new, nu_new, count
-    rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows)
+    rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows,
+                          presorted=ps)
     # promises per the active dedup impl (see sparse_adagrad); clamped
     # gathers keep at most the sorted promise
     fl = dedup_flags()
@@ -727,13 +783,39 @@ def host_apply_rows_inplace(kind: str, table, state, rep, sums, valid, lr,
 # ------------------------------------------------- optimizer description
 class SparseOptimizer(NamedTuple):
     """A (init, update) pair over a single table shard; `update` consumes a
-    SparseRowGrad. `kind` selects the rule; hyper-params are closed over
-    (and kept in `lr`/`hp` for the host-offload apply path)."""
+    SparseRowGrad (plus an optional `presorted` GroupSort of its id
+    stream — the sort-folding seam). `kind` selects the rule; hyper-params
+    are closed over (and kept in `lr`/`hp` for the host-offload apply
+    path)."""
     kind: str
     init: callable       # table -> state pytree (tuple)
-    update: callable     # (table, state, SparseRowGrad) -> (table, state)
-    lr: Any = 0.0
+    update: callable     # (table, state, SparseRowGrad, presorted=None)
+    lr: Any = 0.0        #   -> (table, state)
     hp: tuple = ()       # sorted (key, value) pairs
+    strategy: str = "auto"
+
+
+def update_consumes_sort(kind: str, strategy: str, rows: int,
+                         width: int) -> bool:
+    """Static answer to "would `SparseOptimizer.update` use a presorted
+    GroupSort for a [rows, width] shard?" — mirrors the dispatch in
+    sparse_sgd/adagrad/adam exactly, so forwards can decide at trace time
+    whether producing the artifact is worthwhile (an unconsumed sort is
+    not free: DCE does not reach through shard_map boundaries)."""
+    if jax.default_backend() == "tpu":
+        tiled = ((strategy == "tiled" and bool(_TILED_GATE.verdict))
+                 or (strategy == "auto"
+                     and measured_default("DET_SCATTER_IMPL", "xla")
+                     == "tiled" and bool(_TILED_GATE.verdict)))
+    else:
+        # off-TPU: explicit 'tiled' runs interpret-mode kernels; the
+        # auto+env route is TPU-only (_KernelGate.active)
+        tiled = strategy == "tiled"
+    if tiled:
+        return True                      # all three kinds take (sid, perm)
+    if _pick(strategy, rows, width) != "sort":
+        return False                     # dense path aggregates scatterwise
+    return kind in ("adagrad", "adam")   # sgd's plain scatter needs no order
 
 
 def make_sparse_optimizer(kind: str, lr, strategy: str = "auto",
@@ -744,9 +826,10 @@ def make_sparse_optimizer(kind: str, lr, strategy: str = "auto",
     if kind == "sgd":
         return SparseOptimizer(
             "sgd", lambda table: (),
-            lambda table, state, g: (sparse_sgd(table, g, lr,
-                                                strategy=strategy), ()),
-            lr, hp_t)
+            lambda table, state, g, presorted=None: (
+                sparse_sgd(table, g, lr, strategy=strategy,
+                           presorted=presorted), ()),
+            lr, hp_t, strategy)
     if kind == "adagrad":
         init_acc = hp.get("initial_accumulator_value", 0.1)
         eps = hp.get("eps", 1e-10)
@@ -754,11 +837,11 @@ def make_sparse_optimizer(kind: str, lr, strategy: str = "auto",
         def init(table):
             return (jnp.full(table.shape, init_acc, jnp.float32),)
 
-        def update(table, state, g):
+        def update(table, state, g, presorted=None):
             t, acc = sparse_adagrad(table, state[0], g, lr, eps=eps,
-                                    strategy=strategy)
+                                    strategy=strategy, presorted=presorted)
             return t, (acc,)
-        return SparseOptimizer("adagrad", init, update, lr, hp_t)
+        return SparseOptimizer("adagrad", init, update, lr, hp_t, strategy)
     if kind == "adam":
         b1, b2 = hp.get("b1", 0.9), hp.get("b2", 0.999)
         eps = hp.get("eps", 1e-8)
@@ -768,10 +851,11 @@ def make_sparse_optimizer(kind: str, lr, strategy: str = "auto",
                     jnp.zeros(table.shape, jnp.float32),
                     jnp.zeros((), jnp.int32))
 
-        def update(table, state, g):
+        def update(table, state, g, presorted=None):
             t, mu, nu, c = sparse_adam(table, state[0], state[1], state[2],
                                        g, lr, b1=b1, b2=b2, eps=eps,
-                                       strategy=strategy)
+                                       strategy=strategy,
+                                       presorted=presorted)
             return t, (mu, nu, c)
-        return SparseOptimizer("adam", init, update, lr, hp_t)
+        return SparseOptimizer("adam", init, update, lr, hp_t, strategy)
     raise ValueError(f"Unknown sparse optimizer {kind!r}")

@@ -166,7 +166,7 @@ def _merge_dense(dense, params):
 
 def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
                            dense_optimizer=None, strategy: str = "auto",
-                           donate: bool = True):
+                           donate: bool = True, fold_sort: bool = True):
     """Build a train step whose embedding-table updates are row-wise sparse.
 
     This is the TPU-native analogue of the reference's full sparse training
@@ -192,6 +192,14 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
         (default: the optax twin of `optimizer`).
       strategy: sparse aggregation strategy ('auto' | 'sort' | 'dense' |
         'tiled' — the Pallas one-hot-matmul kernels).
+      fold_sort: sort folding (ISSUE 2, default on): the tapped forward
+        produces each exchange group's canonical id sort ONCE
+        (TapResiduals.tp_sort/row_sort) and the sparse update consumes the
+        precomputed order instead of re-sorting — bit-identical numerics,
+        ≤1 sort op per (bucket, hotness) exchange group in the compiled
+        step (the reference CUDA backward's reuse of forward-sorted ids,
+        embedding_lookup_kernels.cu:706-773). False keeps the unfolded
+        (re-sorting) step, e.g. as the parity baseline in tests.
 
     Returns (init_fn, step_fn):
       init_fn(params) -> opt_state
@@ -229,6 +237,7 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
 
     off_buckets = [b for b in range(len(emb.plan.tp_buckets))
                    if emb._bucket_memory_kind(b)]
+    sort_spec = (optimizer, strategy) if fold_sort else None
 
     def step_fn(params, opt_state, numerical, cats, labels):
         cats = list(cats)
@@ -246,8 +255,12 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
                                  return_residuals=True)
 
         dense0 = _dense_part(params)
-        (loss, res), (g_dense, g_taps) = jax.value_and_grad(
-            loss_with_taps, argnums=(0, 1), has_aux=True)(dense0, taps)
+        # residual_sort_scope is trace-time state: the model's loss_fn
+        # reaches emb.apply without a residual_sort channel of its own, so
+        # the fold spec rides the layer for exactly this traced region
+        with emb.residual_sort_scope(sort_spec):
+            (loss, res), (g_dense, g_taps) = jax.value_and_grad(
+                loss_with_taps, argnums=(0, 1), has_aux=True)(dense0, taps)
         new_emb, new_emb_state, pending = emb.sparse_update(
             params["embedding"], opt_state["emb"], g_taps, res, sopt_t)
         # never emit host-resident leaves as jit outputs (XLA:CPU SPMD cannot

@@ -20,7 +20,34 @@ from typing import Any, Callable, NamedTuple, Sequence
 import jax
 
 __all__ = ["BenchResult", "benchmark", "benchmark_batches", "trace",
-           "annotate", "fetch_sync"]
+           "annotate", "fetch_sync", "hlo_op_counts"]
+
+
+def hlo_op_counts(lowered, ops: Sequence[str] = ("sort", "scatter", "gather",
+                                                 "all_to_all")) -> dict:
+    """Count StableHLO ops in a lowered (not yet compiled) jax program.
+
+    The static twin of a profiler trace: op COUNTS are decided at trace
+    time, so regressions like "the train step re-sorts the same ids three
+    times" (docs/perf_model.md 'Sort folding') are catchable on any
+    backend, hardware or not — tools/hlo_audit.py builds the repo's
+    regression gate on this.
+
+    Args:
+      lowered: a ``jax.jit(f).lower(...)`` result, or its ``.as_text()``
+        string (StableHLO MLIR).
+      ops: StableHLO op mnemonics, counted as whole words (``sort`` counts
+        ``stablehlo.sort`` but not ``sort_key`` identifiers).
+
+    Returns {op: count}. Counts are per textual op instance; an op inside
+    a called sub-function counts once per textual occurrence, not per call
+    site — stable for equality/upper-bound assertions, not a dynamic
+    execution count.
+    """
+    import re
+    text = lowered if isinstance(lowered, str) else lowered.as_text()
+    return {op: len(re.findall(rf'stablehlo\.{re.escape(op)}\b', text))
+            for op in ops}
 
 
 def fetch_sync(out) -> float:

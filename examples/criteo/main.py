@@ -32,6 +32,13 @@ def parse_args(argv=None):
     p.add_argument("--synthetic", action="store_true")
     p.add_argument("--bench_lookup", action="store_true",
                    help="microbenchmark native vs numpy IntegerLookup")
+    p.add_argument("--bench_lookup_keys", type=int, default=1 << 20,
+                   help="total keys for --bench_lookup; use >=10M (with "
+                        "--max_tokens sized above the expected uniques) "
+                        "for reference-like scale (docs/parity.md)")
+    p.add_argument("--bench_lookup_batch", type=int, default=65536,
+                   help="keys per lookup call in --bench_lookup (input-"
+                        "pipeline batch granularity)")
     p.add_argument("--batch_size", type=int, default=4096)
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--max_tokens", type=int, default=100000,
@@ -96,6 +103,55 @@ def main(argv=None):
     from distributed_embeddings_tpu.models.dlrm import _mlp_init, _mlp_apply
 
     n_cat, n_num = args.num_categorical, args.num_numerical
+    if args.bench_lookup:
+        # IntegerLookup microbenchmark: native C++ hash vs numpy fallback,
+        # duplicate-heavy power-law keys (the realistic regime — the batch
+        # pre-unique makes per-unique hash cost the denominator). At
+        # --bench_lookup_keys >= 10M this is the reference-like-scale
+        # measurement docs/parity.md records: the host hash is the
+        # ingestion bound of the raw-keys pipeline (the reference's
+        # cuCollections map is a GPU kernel, .cu:383-516 — TPUs have no
+        # device hash, so the TPU-VM host rate IS the number that matters).
+        import json as _json
+        rng = np.random.RandomState(0)
+        bsz = args.bench_lookup_batch
+        nb = max(2, -(-args.bench_lookup_keys // bsz))
+        keys = (rng.zipf(1.2, size=(nb, bsz)) * 2654435761 % (1 << 40)
+                ).astype(np.int64)
+        bench_rec = {"total_keys": int((nb - 1) * bsz), "batch": bsz,
+                     "max_tokens": args.max_tokens, "zipf_alpha": 1.2,
+                     "unique_keys": int(np.unique(keys[1:]).size)}
+        # the numpy fallback loops Python dict inserts per unique key —
+        # orders of magnitude slower; bound its arm so a >=10M-key native
+        # run doesn't stall behind it (rates, not totals, are compared)
+        numpy_nb = min(nb, max(2, (1 << 21) // bsz))
+        for use_native, label, arm_nb in ((True, "native", nb),
+                                          (False, "numpy", numpy_nb)):
+            lk = IntegerLookup(args.max_tokens, use_native=use_native)
+            if use_native and not lk.native:
+                print("IntegerLookup[native]: backend unavailable, skipped",
+                      flush=True)
+                continue
+            lk(keys[0])  # warm
+            t0 = time.perf_counter()
+            for i in range(1, arm_nb):
+                lk(keys[i])
+            dt = time.perf_counter() - t0
+            rate = (arm_nb - 1) * bsz / dt
+            bench_rec[f"{label}_keys_per_sec"] = round(rate)
+            bench_rec[f"{label}_measured_keys"] = int((arm_nb - 1) * bsz)
+            bench_rec[f"{label}_vocab_after"] = int(lk.size)
+            # ingestion bound: one hashed key per categorical feature per
+            # sample (26 one-hot features in the Criteo layout)
+            bench_rec[f"{label}_samples_per_sec_bound"] = round(
+                rate / args.num_categorical)
+            print(f"IntegerLookup[{label}]: {rate:,.0f} keys/sec over "
+                  f"{(arm_nb - 1) * bsz:,} keys (vocab {lk.size}; implies "
+                  f"<= {rate / args.num_categorical:,.0f} samples/sec at "
+                  f"{args.num_categorical} cat features)", flush=True)
+        print(_json.dumps({"bench_lookup": bench_rec}), flush=True)
+        return
+
     lookups = [IntegerLookup(args.max_tokens) for _ in range(n_cat)]
     print(f"IntegerLookup backend: "
           f"{'native C++' if lookups[0].native else 'numpy (SLOW fallback)'}",
@@ -128,24 +184,6 @@ def main(argv=None):
         updates, s = opt.update(g, s, p)
         return jax.tree.map(lambda a, b: a + b, p, updates), s, loss
 
-    if args.bench_lookup:
-        # IntegerLookup microbenchmark: native C++ hash vs numpy fallback,
-        # duplicate-heavy power-law keys (the realistic regime — the batch
-        # pre-unique makes per-unique hash cost the denominator)
-        rng = np.random.RandomState(0)
-        nb, bsz = 16, args.batch_size
-        keys = (rng.zipf(1.2, size=(nb, bsz)) * 2654435761 % (1 << 40)
-                ).astype(np.int64)
-        for use_native, label in ((True, "native"), (False, "numpy")):
-            lk = IntegerLookup(args.max_tokens, use_native=use_native)
-            lk(keys[0])  # warm
-            t0 = time.perf_counter()
-            for i in range(1, nb):
-                lk(keys[i])
-            dt = time.perf_counter() - t0
-            print(f"IntegerLookup[{label}]: "
-                  f"{(nb - 1) * bsz / dt:,.0f} keys/sec "
-                  f"(vocab {lk.size})", flush=True)
 
     if args.csv:
         batches = csv_batches(args.csv, args.batch_size, n_num, n_cat)

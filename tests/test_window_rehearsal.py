@@ -1,0 +1,44 @@
+"""The unattended-window pipeline rehearsal as a regression test.
+
+VERDICT r5 weak #5 / next #2: the composed watcher-stage → quickab →
+bench → measured-defaults-write → dispatch-flip sequence must be runnable
+end to end on CPU so the first real hardware window cannot be lost to a
+plumbing bug. tools/window_rehearsal.py is the composition; this test runs
+it as the watcher would (one subprocess, bounded) and asserts the green
+verdict. Slow tier: the bench stage alone compiles the tiny synthetic
+model on the CPU backend (execution-bound on the single-core test host).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_window_rehearsal_green(tmp_path):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)       # stages set their own cpu forcing
+    p = subprocess.run(
+        [sys.executable, "-u",
+         os.path.join(ROOT, "tools", "window_rehearsal.py")],
+        capture_output=True, text=True, timeout=3300, env=env, cwd=ROOT)
+    assert p.returncode == 0, (
+        f"rehearsal failed rc={p.returncode}\nstdout:\n{p.stdout[-2000:]}\n"
+        f"stderr:\n{p.stderr[-2000:]}")
+    json_line = [ln for ln in p.stdout.splitlines() if ln.startswith("{")][-1]
+    summary = json.loads(json_line)
+    assert summary["verdict"] == "GREEN"
+    assert summary["flip_verified"] is True
+    assert summary["stages"] == ["bench", "quickab"]
+    assert summary["defaults_knobs_written"] == ["DET_LOOKUP_PATH",
+                                                 "DET_SCATTER_IMPL"]
+    # the committed green-log artifact regenerates on every run
+    log = os.path.join(ROOT, "tools", "window_rehearsal_cpu.out")
+    with open(log) as f:
+        text = f.read()
+    assert "rehearsal GREEN" in text

@@ -1,0 +1,151 @@
+"""HLO op-count audit for the compiled tapped sparse train step.
+
+The sort-folding work (ISSUE 2, docs/perf_model.md "Sort folding") is a
+TRACE-TIME property: the folded step must contain at most one stablehlo.sort
+per (bucket, hotness) exchange group — one more (the inverse-permute sort)
+when the tiled forward gather is active. That is checkable on any backend
+without hardware, which makes it both the regression gate for the fold and
+the attribution artifact for the day a TPU window opens: if the measured
+step is slow AND the audit says the sort count regressed, the cause is
+already isolated.
+
+Usage:
+  python tools/hlo_audit.py            # print one JSON line per arm
+  python tools/hlo_audit.py --assert   # exit 1 if any folded arm exceeds
+                                       # its sort bound (CI gate)
+
+Library use: ``audit_tapped_step(...)`` returns the counts for one
+configuration; bench.py embeds a compact audit in its JSON record
+(``hlo_sort_audit``) so every hardware measurement carries the op-count
+fingerprint of the step it timed.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_model(vocab: int, width: int, combiner: str):
+    import jax.numpy as jnp
+    from distributed_embeddings_tpu.layers.dist_model_parallel import (
+        DistributedEmbedding)
+    from distributed_embeddings_tpu.layers.embedding import Embedding
+
+    class _Tapped:
+        """Minimal model shape make_sparse_train_step expects."""
+
+        def __init__(self, emb):
+            self.embedding = emb
+
+        def loss_fn(self, p, numerical, cats, labels, taps=None,
+                    return_residuals=False):
+            out = self.embedding(p["embedding"], list(cats), taps=taps,
+                                 return_residuals=return_residuals)
+            outs, res = out if return_residuals else (out, None)
+            x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs],
+                                axis=1)
+            loss = jnp.mean((jnp.sum(x, axis=1) - labels.reshape(-1)) ** 2)
+            return (loss, res) if return_residuals else loss
+
+    emb = DistributedEmbedding([Embedding(vocab, width, combiner=combiner)],
+                               mesh=None)
+    return _Tapped(emb)
+
+
+def audit_tapped_step(vocab: int = 30_000_000, width: int = 8,
+                      batch: int = 8, hotness: int = 4,
+                      optimizer: str = "adagrad", strategy: str = "sort",
+                      lookup_path: str = None, fold: bool = True,
+                      combiner: str = "sum") -> dict:
+    """Lower one tapped sparse train step (abstract avals — no giant table
+    is materialized) and count its StableHLO ops. Returns the counts plus
+    the exchange-group count the sort bound is measured against."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_embeddings_tpu.training import make_sparse_train_step
+    from distributed_embeddings_tpu.utils.profiling import hlo_op_counts
+
+    prev = os.environ.get("DET_LOOKUP_PATH")
+    try:
+        if lookup_path is None:
+            os.environ.pop("DET_LOOKUP_PATH", None)
+        else:
+            os.environ["DET_LOOKUP_PATH"] = lookup_path
+        model = _build_model(vocab, width, combiner)
+        emb = model.embedding
+        init_fn, step_fn = make_sparse_train_step(
+            model, optimizer, lr=0.01, strategy=strategy, fold_sort=fold)
+        params = jax.eval_shape(
+            lambda: {"embedding": emb.init(jax.random.PRNGKey(0))})
+        state = jax.eval_shape(init_fn, params)
+        num = jax.ShapeDtypeStruct((batch, 1), jnp.float32)
+        cats = [jax.ShapeDtypeStruct((batch, hotness), jnp.int32)]
+        lab = jax.ShapeDtypeStruct((batch,), jnp.float32)
+        lowered = jax.jit(step_fn).lower(params, state, num, cats, lab)
+        counts = hlo_op_counts(lowered)
+        key = ((hotness, False),)
+        groups, _ = emb._exchange_groups_for_key(key)
+        n_groups = len(groups)
+    finally:
+        if prev is None:
+            os.environ.pop("DET_LOOKUP_PATH", None)
+        else:
+            os.environ["DET_LOOKUP_PATH"] = prev
+    # the bound the fold ships under: one canonical sort per exchange
+    # group, plus the tiled forward gather's inverse-permute sort (the one
+    # residual sort — scatter-free inversion needs a second sort op)
+    bound = n_groups * (2 if lookup_path == "tiled" else 1)
+    return {
+        "optimizer": optimizer, "strategy": strategy,
+        "lookup_path": lookup_path or "default", "fold": fold,
+        "n_exchange_groups": n_groups, "sort_bound": bound,
+        **{f"hlo_{k}": v for k, v in counts.items()},
+    }
+
+
+DEFAULT_ARMS = (
+    # (optimizer, strategy, lookup_path)
+    ("adagrad", "sort", None),
+    ("adagrad", "tiled", None),
+    ("adam", "sort", None),
+    ("sgd", "tiled", None),
+    ("adagrad", "tiled", "tiled"),
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--assert", dest="do_assert", action="store_true",
+                   help="exit 1 when a folded arm exceeds its sort bound")
+    p.add_argument("--vocab", type=int, default=30_000_000)
+    p.add_argument("--width", type=int, default=8)
+    p.add_argument("--unfolded", action="store_true",
+                   help="also report the fold_sort=False baseline arms")
+    args = p.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS") or "cpu")
+    failures = []
+    for optimizer, strategy, lookup in DEFAULT_ARMS:
+        folds = (True, False) if args.unfolded else (True,)
+        for fold in folds:
+            rec = audit_tapped_step(vocab=args.vocab, width=args.width,
+                                    optimizer=optimizer, strategy=strategy,
+                                    lookup_path=lookup, fold=fold)
+            if fold and rec["hlo_sort"] > rec["sort_bound"]:
+                rec["over_bound"] = True
+                failures.append(rec)
+            print(json.dumps(rec), flush=True)
+    if args.do_assert and failures:
+        print(f"hlo_audit: {len(failures)} folded arm(s) exceed the sort "
+              "bound", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,7 +25,11 @@ def main():
     dev = jax.devices()[0]
     out = {"device": f"{dev.platform}:{getattr(dev, 'device_kind', '?')}",
            "started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
-    if dev.platform == "cpu":
+    if dev.platform == "cpu" and os.environ.get(
+            "DET_QUICKAB_ALLOW_CPU") != "1":
+        # DET_QUICKAB_ALLOW_CPU=1: the unattended-window rehearsal
+        # (tools/window_rehearsal.py) runs this stage on CPU with shrunken
+        # shapes (DET_QUICKAB_BATCH/ITERS) to validate the plumbing
         out["verdict"] = "SKIP cpu backend"
         print(json.dumps(out), flush=True)
         return
@@ -43,7 +47,8 @@ def main():
     from distributed_embeddings_tpu.ops import sparse_update
 
     cfg = SYNTHETIC_MODELS["tiny"]
-    batch, iters = 65536, 8
+    batch = int(os.environ.get("DET_QUICKAB_BATCH", 65536))
+    iters = int(os.environ.get("DET_QUICKAB_ITERS", 8))
     out["git_sha"] = bench._git_sha()
     t0 = time.perf_counter()
     try:
