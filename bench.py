@@ -482,6 +482,262 @@ def serve_main(argv=None) -> int:
     return 0 if "serve_error" not in record else 1
 
 
+# ---------------------------------------------------------------- ingest
+def _write_ingest_files(tmpdir: str, distinct: int, batch: int,
+                        features: int, numerical: int, alpha: float,
+                        seed: int) -> dict:
+    """Materialize a split-binary-like synthetic dataset on disk: raw int64
+    power-law keys (feature-major per batch, so per-feature reads are
+    contiguous like cat_i.bin), f16 numericals, bool labels. The read stage
+    preads real bytes; cycling `distinct` batches keeps the file small and
+    the page cache warm (steady-state regime — the vocab is fully built
+    after the first cycle, exactly the duplicate-heavy regime docs/parity.md
+    measures the hash at)."""
+    rng = np.random.RandomState(seed)
+    sizes = {"keys": features * batch * 8, "numerical": numerical * batch * 2,
+             "label": batch}
+    paths = {k: os.path.join(tmpdir, f"{k}.bin") for k in sizes}
+    files = {k: open(p, "wb") for k, p in paths.items()}
+    try:
+        for _ in range(distinct):
+            keys = (rng.zipf(alpha, size=(features, batch)) * 2654435761
+                    % (1 << 40)).astype(np.int64)
+            files["keys"].write(keys.tobytes())
+            files["numerical"].write(
+                rng.rand(batch, numerical).astype(np.float16).tobytes())
+            files["label"].write(
+                rng.randint(0, 2, batch).astype(np.bool_).tobytes())
+    finally:
+        for f in files.values():
+            f.close()
+    return {"paths": paths, "sizes": sizes}
+
+
+def make_ingest_step(lr: float = 0.05):
+    """The consumer: a jitted sparse-update train step stand-in — gather
+    [B, F] rows, sum-combine, logistic head, manual backward with a
+    row-wise scatter-add table update (the embedding-bound shape of the
+    real sparse path; device cost scales with batch x features x dim like
+    training does). Donated table/head buffers update in place."""
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(table, w, numerical, idx, labels):
+        rows = table[idx]                          # [B, F, D] gather
+        h = rows.sum(axis=1)                       # [B, D] sum combiner
+        k = min(h.shape[1], numerical.shape[1])    # static inside jit
+        h = h.at[:, :k].add(numerical[:, :k])
+        logits = h @ w                             # [B]
+        y = labels[:, 0]
+        loss = jnp.mean(jnp.maximum(logits, 0) - logits * y
+                        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        dlogits = (jax.nn.sigmoid(logits) - y) / logits.shape[0]
+        dw = h.T @ dlogits                         # [D]
+        dh = dlogits[:, None] * w[None, :]         # [B, D]
+        drows = jnp.broadcast_to(dh[:, None, :], rows.shape)
+        table = table.at[idx].add(-lr * drows)     # sparse row update
+        return table, w - lr * dw, loss
+
+    return step
+
+
+def run_ingest_bench(batches: int = 32, batch: int = 16384,
+                     features: int = 26, numerical: int = 13,
+                     dim: int = 16, max_tokens: int = 1 << 19,
+                     alpha: float = 1.2, distinct: int = 8,
+                     depth: int = 2, seed: int = 0, reps: int = 3) -> dict:
+    """Ingestion benchmark: serial vs pipelined end-to-end samples/s.
+
+    The end-to-end loop is read (pread) -> preprocess (IntegerLookup hash +
+    min-dtype cast + feature split, one fused pass) -> stage (device_put) ->
+    consume (jitted sparse-update step, loss fetched per batch — the CPU
+    `fit` lockstep semantics). The serial arm runs every stage in the
+    consumer thread (the seed's behavior); the pipelined arm runs the three
+    host stages in persistent background workers (utils.pipeline) so they
+    hide under the device step. Per-stage wall times ride in the record;
+    the pipelined rate should approach the slowest single-stage bound
+    instead of the sum of stages. Runs on any backend incl. CPU (the
+    tier-1 smoke path) — the whole optimisation is host-side.
+    """
+    import tempfile
+    import shutil
+    from distributed_embeddings_tpu.layers.embedding import IntegerLookup
+    from distributed_embeddings_tpu.utils.metrics import LatencyHistogram
+    from distributed_embeddings_tpu.utils.pipeline import (IngestPipeline,
+                                                           SerialPipeline)
+
+    tmpdir = tempfile.mkdtemp(prefix="det_ingest_")
+    try:
+        layout = _write_ingest_files(tmpdir, distinct, batch, features,
+                                     numerical, alpha, seed)
+        paths, sizes = layout["paths"], layout["sizes"]
+        fds = {k: os.open(p, os.O_RDONLY) for k, p in paths.items()}
+        try:
+            lookups = [IntegerLookup(max_tokens) for _ in range(features)]
+
+            def read(i):
+                d = i % distinct
+                return {k: os.pread(fds[k], sizes[k], d * sizes[k])
+                        for k in fds}
+
+            def preprocess(bufs):
+                # one fused pass over the raw batch: hash translate per
+                # feature (contiguous slices), min-dtype cast, feature
+                # stack, f16 -> f32 numericals, label reshape
+                keys = np.frombuffer(bufs["keys"], np.int64).reshape(
+                    features, batch)
+                idx = np.empty((batch, features), np.int32)
+                for f in range(features):
+                    idx[:, f] = lookups[f](keys[f])
+                num = np.frombuffer(bufs["numerical"], np.float16).reshape(
+                    batch, numerical).astype(np.float32)
+                labels = np.frombuffer(
+                    bufs["label"], np.bool_).astype(np.float32)[:, None]
+                return num, idx, labels
+
+            def stage(b):
+                return jax.device_put(b)
+
+            step = make_ingest_step()
+            rng = np.random.RandomState(seed + 1)
+            table0 = rng.rand(max_tokens + 1, dim).astype(np.float32) * 0.01
+            w0 = rng.rand(dim).astype(np.float32) * 0.01
+
+            def consume_loop(it, consume_hist):
+                """Drive the consumer over `it`; fetch-sync the loss each
+                batch (block_until_ready lies on some backends; a host
+                fetch cannot)."""
+                table = jax.device_put(table0.copy())
+                w = jax.device_put(w0.copy())
+                n, lv = 0, 0.0
+                for num, idx, labels in it:
+                    t0 = time.perf_counter()
+                    table, w, loss = step(table, w, num, idx, labels)
+                    lv = float(loss)
+                    consume_hist.record(time.perf_counter() - t0)
+                    n += 1
+                if not np.isfinite(lv):
+                    raise RuntimeError(f"non-finite ingest loss: {lv}")
+                return n
+
+            stages = [("preprocess", preprocess), ("stage", stage)]
+
+            def src(n):
+                return (read(i) for i in range(n))
+
+            # warmup OFF the clock: one full cycle builds every vocab
+            # (after it, the key stream is all-hits — steady state), plus
+            # the step compile and the page cache
+            consume_loop(SerialPipeline(src(distinct), stages),
+                         LatencyHistogram())
+
+            # interleaved arms x reps, best-of-reps per arm: the shared-vCPU
+            # host shows multi-second steal windows (same mitigation class
+            # as run_at_batch's slope timing) — a single paired run can
+            # charge a steal burst to either arm; the best rep per arm is
+            # the contention-free estimate and every rep rides along in
+            # ingest_raw for honesty
+            arms = (("serial",
+                     lambda: SerialPipeline(src(batches), stages)),
+                    ("pipelined",
+                     lambda: IngestPipeline(src(batches), stages,
+                                            depth=depth)))
+            results = {}
+            raw = []
+            for rep in range(max(1, reps)):
+                for label, make_pipe in arms:
+                    pipe = make_pipe()
+                    consume_hist = LatencyHistogram()
+                    t0 = time.perf_counter()
+                    n = consume_loop(pipe, consume_hist)
+                    dt = max(time.perf_counter() - t0, 1e-9)
+                    pipe.close()
+                    stage_ms = {name: s["mean_ms"] for name, s
+                                in pipe.stage_summaries().items()}
+                    stage_ms["consume"] = consume_hist.summary()["mean_ms"]
+                    res = {"samples_per_sec": round(n * batch / dt),
+                           "wall_s": round(dt, 3), "stage_ms": stage_ms}
+                    raw.append({"rep": rep, "arm": label, **res})
+                    if (label not in results or res["samples_per_sec"]
+                            > results[label]["samples_per_sec"]):
+                        results[label] = res
+
+            ser = results["serial"]["samples_per_sec"]
+            pip = results["pipelined"]["samples_per_sec"]
+            pip_stage_ms = results["pipelined"]["stage_ms"]
+            bottleneck = max(pip_stage_ms, key=pip_stage_ms.get)
+            bound = round(batch / (pip_stage_ms[bottleneck] / 1e3)) \
+                if pip_stage_ms[bottleneck] else 0
+            return {
+                "metric": "ingest_serial_vs_pipelined_powerlaw",
+                "backend": jax.devices()[0].platform,
+                "ingest_batch": batch,
+                "ingest_batches": batches,
+                "ingest_features": features,
+                "ingest_numerical": numerical,
+                "ingest_dim": dim,
+                "ingest_max_tokens": max_tokens,
+                "ingest_zipf_alpha": alpha,
+                "ingest_depth": depth,
+                "ingest_serial_samples_per_sec": ser,
+                "ingest_pipelined_samples_per_sec": pip,
+                "ingest_speedup": round(pip / ser, 3) if ser else 0.0,
+                "ingest_serial_stage_ms": results["serial"]["stage_ms"],
+                "ingest_pipelined_stage_ms": pip_stage_ms,
+                "ingest_bottleneck_stage": bottleneck,
+                "ingest_stage_bound_samples_per_sec": bound,
+                "ingest_vs_stage_bound": round(pip / bound, 3) if bound
+                else 0.0,
+                "ingest_reps": max(1, reps),
+                "ingest_raw": raw,
+                "ingest_vocab_built": int(sum(lk.size for lk in lookups)),
+                "git_sha": _git_sha(),
+            }
+        finally:
+            for fd in fds.values():
+                os.close(fd)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def ingest_main(argv=None) -> int:
+    """`bench.py --mode ingest` entry point: one JSON line, like main()."""
+    import argparse
+    p = argparse.ArgumentParser(description="ingestion pipeline benchmark")
+    p.add_argument("--mode", choices=["ingest"], default="ingest")
+    p.add_argument("--batches", type=int, default=32)
+    p.add_argument("--batch", type=int, default=16384)
+    p.add_argument("--features", type=int, default=26)
+    p.add_argument("--numerical", type=int, default=13)
+    p.add_argument("--dim", type=int, default=16)
+    p.add_argument("--max_tokens", type=int, default=1 << 19)
+    p.add_argument("--alpha", type=float, default=1.2)
+    p.add_argument("--distinct", type=int, default=8)
+    p.add_argument("--depth", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--reps", type=int, default=3,
+                   help="interleaved serial/pipelined repetitions; the "
+                        "headline takes each arm's best rep (steal-window "
+                        "robust), all reps ride in ingest_raw")
+    args = p.parse_args(argv)
+    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        record = run_ingest_bench(
+            batches=args.batches, batch=args.batch, features=args.features,
+            numerical=args.numerical, dim=args.dim,
+            max_tokens=args.max_tokens, alpha=args.alpha,
+            distinct=args.distinct, depth=args.depth, seed=args.seed,
+            reps=args.reps)
+    except Exception as e:  # noqa: BLE001 - one JSON line, like main()
+        import traceback
+        traceback.print_exc()
+        record = {"metric": "ingest_serial_vs_pipelined_powerlaw",
+                  "ingest_error": str(e)[:300], "git_sha": _git_sha()}
+    print(json.dumps(record))
+    return 0 if "ingest_error" not in record else 1
+
+
 # ---------------------------------------------------------------- roofline
 # v5e per-chip peaks (public spec); used only for the efficiency estimate.
 HBM_GBPS = {"v5e": 819.0, "v5p": 2765.0, "v4": 1228.0}
@@ -946,6 +1202,8 @@ def _cli_mode() -> str:
 if __name__ == "__main__":
     if _cli_mode() == "serve":
         sys.exit(serve_main(sys.argv[1:]))
+    elif _cli_mode() == "ingest":
+        sys.exit(ingest_main(sys.argv[1:]))
     elif os.environ.get("DET_BENCH_INNER") == "1":
         main()
     else:

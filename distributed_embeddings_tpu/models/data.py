@@ -53,6 +53,10 @@ class DummyDataset:
 class RawBinaryDataset:
     """Split-binary Criteo dataset with native prefetch.
 
+    The read and decode halves are separately exposed (`read_raw` /
+    `preprocess`) so `utils.pipeline.IngestPipeline` can run them in
+    dedicated worker threads; `ds[idx]` composes them inline.
+
     Args:
       data_path: directory containing train/ or test/ with label.bin,
         numerical.bin, cat_{i}.bin.
@@ -168,6 +172,19 @@ class RawBinaryDataset:
         for req, buf in self._pending.pop(idx):
             self._prefetcher_lib.pf_wait(self._prefetcher, req)
             bufs.append(buf)
+        return bufs
+
+    def preprocess(self, bufs):
+        """Decode raw byte buffers (from `read_raw`) into a batch.
+
+        THE preprocess hook of the ingestion pipeline: dtype views, the
+        min-int -> int32 cast, the f16 -> f32 numerical cast, the label
+        reshape and the dp/mp slicing all happen here — in whatever thread
+        the caller runs it in (`utils.pipeline.IngestPipeline` gives it a
+        dedicated worker so it overlaps the device step). Subclass or wrap
+        it to fuse extra host transforms (e.g. an IntegerLookup raw-key
+        translation) into the same single pass over the batch.
+        """
         return self._decode(bufs)
 
     def _decode(self, bufs):
@@ -190,7 +207,14 @@ class RawBinaryDataset:
                 cats = [c[sl] for c in cats]
         return numerical, cats, labels
 
-    def __getitem__(self, idx: int):
+    def read_raw(self, idx: int):
+        """Raw per-file byte buffers for batch `idx` — the read stage.
+
+        Pure I/O: pread (native async prefetch window when available) with
+        no decoding, so an ingestion pipeline can run it in a reader thread
+        while `preprocess` and device staging proceed on earlier batches.
+        `__getitem__` remains `preprocess(read_raw(idx))`.
+        """
         if idx >= self._num_entries:
             raise IndexError
         if self._prefetcher is None or self.prefetch_depth <= 1:
@@ -202,7 +226,7 @@ class RawBinaryDataset:
                 nbytes = self._cat_bytes[cat_id]
                 bufs.append(self._read(self._cat_file_idx[cat_id],
                                        idx * nbytes, nbytes))
-            return self._decode(bufs)
+            return bufs
         # async: keep prefetch_depth batches in flight
         if idx == 0:
             self._pending.clear()
@@ -212,6 +236,17 @@ class RawBinaryDataset:
         if nxt < self._num_entries and nxt not in self._pending:
             self._start_batch(nxt)
         return self._finish_batch(idx)
+
+    def raw_batches(self, steps: Optional[int] = None):
+        """Generator over raw (undecoded) batches, wrapping indices — the
+        natural `IngestPipeline` source: pair with
+        ``stages=[("preprocess", ds.preprocess), ("stage", ...)]``."""
+        n = steps if steps is not None else self._num_entries
+        for i in range(n):
+            yield self.read_raw(i % self._num_entries)
+
+    def __getitem__(self, idx: int):
+        return self.preprocess(self.read_raw(idx))
 
     def __del__(self):
         try:

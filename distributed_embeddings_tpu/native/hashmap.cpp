@@ -10,9 +10,14 @@
 //
 // Build: g++ -O3 -shared -fPIC hashmap.cpp -o _det_native.so
 
+#include <unistd.h>
+
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <functional>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -77,12 +82,124 @@ struct IntegerLookupMap {
   }
 };
 
-// Threads for an n-key batch: parallelism only pays past ~32k keys
-// (thread spawn ~10us each); capped so giant batches don't oversubscribe.
+// Persistent worker pool for the per-batch probe parallelism. The previous
+// implementation spawned std::thread per batch (~10us each) — measurable
+// against a ~1ms 16k-key probe, and paid on EVERY lookup call. Workers here
+// are created once (lazily, hardware_concurrency - 1 of them: the caller
+// always runs chunk 0 itself) and parked on a condition variable between
+// batches; dispatch cost is one lock + notify (~1us).
+//
+// The pool object is intentionally leaked: the library is loaded via ctypes
+// and never dlclosed, and joining detached workers from a static destructor
+// during interpreter teardown is a known crash source. Workers exit with
+// the process.
+class WorkerPool {
+ public:
+  static WorkerPool& instance() {
+    static WorkerPool* pool = new WorkerPool();  // leaked by design
+    return *pool;
+  }
+
+  // Run fn(worker_index) on `nt - 1` pool workers (indices 1..nt-1) while
+  // the caller runs index 0; returns when all are done. Serialized per
+  // process (one batch in flight) — callers already hold the Python-side
+  // map lock, and a single pool avoids oversubscribing the host.
+  void run(int nt, const std::function<void(int)>& fn) {
+    std::unique_lock<std::mutex> lk(run_mu_);
+    // fork safety: a child inherits this object but none of its worker
+    // THREADS — dispatching to them would wait on done_cv_ forever.
+    // Workers are (re)spawned lazily on the first run() in each process
+    // (also avoids paying hw-1 thread spawns in processes that only ever
+    // do small single-threaded lookups). Residual risk: forking WHILE
+    // another thread is inside a lookup is UB (inherited locked mutexes)
+    // — the Python wrapper's per-map lock makes that a caller bug.
+    if (pid_ != getpid()) {
+      threads_.clear();  // detached std::threads: clearing is safe
+      generation_ = 0;
+      active_ = 0;
+      task_workers_ = 0;
+      for (int i = 0; i < max_workers_; ++i) {
+        threads_.emplace_back([this, i] { Loop(i + 1); });
+        threads_.back().detach();  // leaked pool: never joined
+      }
+      pid_ = getpid();
+    }
+    int workers = nt - 1;
+    if (workers > static_cast<int>(threads_.size()))
+      workers = static_cast<int>(threads_.size());
+    if (workers > 0) {
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        task_ = &fn;
+        task_workers_ = workers;
+        active_ = workers;
+        ++generation_;
+      }
+      cv_.notify_all();
+    }
+    fn(0);
+    if (workers > 0) {
+      std::unique_lock<std::mutex> g(mu_);
+      done_cv_.wait(g, [&] { return active_ == 0; });
+      task_ = nullptr;
+    }
+  }
+
+  // Potential parallelism (caller + workers); workers spawn lazily on the
+  // first run() so small-batch-only processes never pay the thread spawns.
+  int max_threads() const { return max_workers_ + 1; }
+
+ private:
+  WorkerPool() {
+    int hw = static_cast<int>(std::thread::hardware_concurrency());
+    max_workers_ = hw > 1 ? hw - 1 : 0;
+    if (max_workers_ > 31) max_workers_ = 31;  // caller + 31 = old 32 cap
+  }
+
+  void Loop(int index) {
+    uint64_t seen = 0;
+    for (;;) {
+      const std::function<void(int)>* task = nullptr;
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        cv_.wait(g, [&] { return generation_ != seen; });
+        seen = generation_;
+        // not needed for this batch: only participants (index <=
+        // task_workers_) touch active_, so just go back to sleep
+        if (index > task_workers_) continue;
+        task = task_;
+      }
+      (*task)(index);
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        if (--active_ == 0) done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::mutex run_mu_;  // one batch in flight at a time
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  const std::function<void(int)>* task_ = nullptr;
+  int task_workers_ = 0;
+  int active_ = 0;
+  int max_workers_ = 0;
+  pid_t pid_ = -1;   // owner process: workers respawn lazily after fork
+  uint64_t generation_ = 0;
+  std::vector<std::thread> threads_;
+};
+
+// Threads for an n-key batch. With the persistent pool, dispatch is ~1us
+// (vs ~10us+ per spawned thread before), but parallel probing also fights
+// cache sharing and the relaxed atomic hit-count adds on hot power-law
+// keys — measured on the 2-vCPU reference host (docs/parity.md), the
+// multi-thread probe only breaks even around 64k keys/batch and loses
+// below it (e.g. 15.3 vs 18.9 M keys/s at 16k). So: single thread under
+// 64k keys, then >=32k keys per thread, capped by the pool size.
 inline int threads_for(int64_t n) {
-  int hw = static_cast<int>(std::thread::hardware_concurrency());
-  if (hw <= 1 || n < (1 << 15)) return 1;
-  int64_t want = n >> 14;  // ~16k keys per thread minimum
+  int hw = WorkerPool::instance().max_threads();
+  if (hw <= 1 || n < (1 << 16)) return 1;
+  int64_t want = n >> 15;  // ~32k keys per thread minimum
   if (want > hw) want = hw;
   if (want > 32) want = 32;
   return static_cast<int>(want);
@@ -95,16 +212,12 @@ inline void parallel_chunks(int64_t n, Fn fn) {
     fn(0, n);
     return;
   }
-  std::vector<std::thread> ts;
-  ts.reserve(nt);
   int64_t chunk = (n + nt - 1) / nt;
-  for (int t = 0; t < nt; ++t) {
+  WorkerPool::instance().run(nt, [&](int t) {
     int64_t lo = t * chunk;
     int64_t hi = lo + chunk < n ? lo + chunk : n;
-    if (lo >= hi) break;
-    ts.emplace_back([=] { fn(lo, hi); });
-  }
-  for (auto& t : ts) t.join();
+    if (lo < hi) fn(lo, hi);
+  });
 }
 
 }  // namespace

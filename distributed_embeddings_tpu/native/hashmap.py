@@ -34,7 +34,11 @@ class NativeIntegerLookup:
 
     @property
     def size(self) -> int:
-        return int(self._lib.il_size(self._handle))
+        # locked like the mutating calls: an ingestion worker may be inside
+        # phase-2 insert (non-atomic ++size) while a consumer thread polls
+        # progress (e.g. the examples' vocab log line)
+        with self._call_lock:
+            return int(self._lib.il_size(self._handle))
 
     def lookup_or_insert(self, keys: np.ndarray) -> np.ndarray:
         keys = np.ascontiguousarray(keys, dtype=np.int64)
@@ -53,13 +57,17 @@ class NativeIntegerLookup:
         return out
 
     def keys_in_index_order(self):
-        n = self.size
-        out = np.empty((n,), dtype=np.int64)
-        if n:
-            self._lib.il_export_keys(self._handle, out.ctypes.data)
+        # one lock for the size read AND the export: racing an insert could
+        # otherwise memcpy keys_by_index mid-realloc
+        with self._call_lock:
+            n = int(self._lib.il_size(self._handle))
+            out = np.empty((n,), dtype=np.int64)
+            if n:
+                self._lib.il_export_keys(self._handle, out.ctypes.data)
         return out.tolist()
 
     def counts(self) -> np.ndarray:
         out = np.zeros((self.capacity,), dtype=np.int64)
-        self._lib.il_export_counts(self._handle, out.ctypes.data)
+        with self._call_lock:
+            self._lib.il_export_counts(self._handle, out.ctypes.data)
         return out

@@ -323,7 +323,8 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         lr=0.01, sparse: bool = True, opt_state=None, dense_optimizer=None,
         callbacks=(), eval_data=None, eval_every: int = 0,
         eval_steps: int = 16, log_every: int = 100, log_fn=print,
-        stage=None, sync_every=None):
+        stage=None, sync_every=None, preprocess=None, pipelined: bool = True,
+        pipeline_depth: int = 2):
     """Minimal training-loop driver — the role the reference fills with
     Keras `model.fit` + `DistributedOptimizer` + callbacks
     (reference dist_model_parallel.py:1270-1326, synthetic main.py:104-114).
@@ -347,12 +348,22 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         `on_step(step, params, loss)` hooks (loss is a device scalar —
         call float() in the callback only if you accept a sync).
       eval_data / eval_every / eval_steps: run `evaluate` periodically.
-      stage: per-batch staging function forwarded to prefetch_to_device
+      stage: per-batch staging function applied in the ingestion pipeline
         for iterable `data` (e.g. ``lambda b: stage_dp_batch(mesh, b)``).
         Default: mesh-aware dp staging when the model has a mesh, plain
         device_put otherwise. Multi-process numpy iterables require the
         mesh-aware form — a committed single-device array cannot be
         resharded onto a non-addressable global mesh.
+      preprocess: optional host transform run between the reader and the
+        staging worker (e.g. ``RawBinaryDataset.preprocess`` when `data`
+        yields raw buffers, or an IntegerLookup raw-key translation).
+        Iterable `data` only.
+      pipelined: True (default) runs read/preprocess/stage each in a
+        persistent background worker (utils.pipeline.IngestPipeline) so
+        host ingestion overlaps the device step; False keeps the serial
+        inline form (identical batch order — the A/B baseline). Iterable
+        `data` only; callable `data` is always pulled inline.
+      pipeline_depth: bound of each inter-stage queue (backpressure).
       sync_every: block on the loss every N steps. Default: 1 on
         multi-process runs (keeps per-process collectives in lockstep)
         and on the CPU backend (XLA:CPU's in-process collectives can
@@ -390,19 +401,28 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
                             or jax.default_backend() == "cpu") else 0)
 
     get_batch = data if callable(data) else None
+    pipeline = None
     if get_batch is None:
-        # keep 2 batches staged ahead on device: host->HBM transfers overlap
-        # the async-dispatched previous step (reference's prefetch executor
-        # role, examples/dlrm/utils.py:231-254)
-        from distributed_embeddings_tpu.utils.prefetch import (
-            prefetch_to_device)
+        # full ingestion overlap: read, preprocess and device staging each
+        # run in a persistent worker thread ahead of the consumer, so the
+        # host-side batch cost hides under the device step (the reference's
+        # prefetch-executor role, examples/dlrm/utils.py:231-254, extended
+        # to every stage — docs/perf_model.md "Ingestion pipeline")
+        from distributed_embeddings_tpu.utils.pipeline import staged_batches
         if stage is None:
             mesh = getattr(getattr(model, "embedding", None), "mesh", None)
             if mesh is not None:
                 from distributed_embeddings_tpu.parallel.staging import (
                     stage_dp_batch)
                 stage = lambda b: stage_dp_batch(mesh, b)  # noqa: E731
-        it = prefetch_to_device(data, stage=stage)
+        # islice: the background reader must never pull past the batches
+        # this run will consume — an over-pull would silently eat items
+        # from a shared/reused source iterator when close() drains
+        import itertools
+        pipeline = staged_batches(itertools.islice(iter(data), steps),
+                                  stage=stage, preprocess=preprocess,
+                                  depth=pipeline_depth, pipelined=pipelined)
+        it = iter(pipeline)
     else:
         it = None
     history = {"loss": []}
@@ -414,49 +434,79 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         history["loss"].extend(float(l) for l in jax.device_get(pending))
         pending.clear()
 
-    for step in range(steps):
-        batch = get_batch(step) if get_batch else next(it)
-        numerical, cats, labels = batch
-        params, opt_state, loss = step_fn(params, opt_state,
-                                          jnp.asarray(numerical),
-                                          [jnp.asarray(c) for c in cats],
-                                          jnp.asarray(labels))
-        pending.append(loss)
-        if sync_every and (step + 1) % sync_every == 0:
-            drain()                       # explicit lockstep barrier
-        if log_every and step % log_every == 0:
-            drain()
-            log_fn(f"step {step}/{steps}: loss={history['loss'][-1]:.5f}")
-        elif len(pending) >= 4096:
-            drain()    # no-sync runs still bound live device buffers
-        for cb in callbacks:
-            if hasattr(cb, "on_step"):
-                cb.on_step(step, params, loss)
-        if eval_data is not None and eval_every and \
-                (step + 1) % eval_every == 0:
-            auc = evaluate(model, params, eval_data, eval_steps)
-            history.setdefault("eval_auc", []).append(auc)
-            log_fn(f"step {step}: eval AUC={auc:.5f}")
+    try:
+        for step in range(steps):
+            batch = get_batch(step) if get_batch else next(it)
+            numerical, cats, labels = batch
+            params, opt_state, loss = step_fn(params, opt_state,
+                                              jnp.asarray(numerical),
+                                              [jnp.asarray(c) for c in cats],
+                                              jnp.asarray(labels))
+            pending.append(loss)
+            if sync_every and (step + 1) % sync_every == 0:
+                drain()                       # explicit lockstep barrier
+            if log_every and step % log_every == 0:
+                drain()
+                log_fn(f"step {step}/{steps}: loss={history['loss'][-1]:.5f}")
+            elif len(pending) >= 4096:
+                drain()    # no-sync runs still bound live device buffers
+            for cb in callbacks:
+                if hasattr(cb, "on_step"):
+                    cb.on_step(step, params, loss)
+            if eval_data is not None and eval_every and \
+                    (step + 1) % eval_every == 0:
+                auc = evaluate(model, params, eval_data, eval_steps)
+                history.setdefault("eval_auc", []).append(auc)
+                log_fn(f"step {step}: eval AUC={auc:.5f}")
+    finally:
+        if pipeline is not None:
+            # ingestion accounting rides the history so callers (and the
+            # bench record) can see where host time went this run
+            history["ingest_stages"] = pipeline.stage_summaries()
+            pipeline.close()
     drain()
     return params, opt_state, history
 
 
-def evaluate(model, params, data, steps: int = 16) -> float:
+def evaluate(model, params, data, steps: int = 16, preprocess=None,
+             pipelined: bool = True) -> float:
     """Streaming AUC over `steps` batches (the reference's eval loop,
     examples/dlrm/main.py:223-243, without the hvd.allgather — outputs are
-    already global jax.Arrays under SPMD)."""
+    already global jax.Arrays under SPMD). Iterable `data` is pulled through
+    the background ingestion pipeline (read/preprocess workers) like `fit`;
+    staging stays in the consumer here because the forward's inputs are
+    tiny and eval runs are short."""
     from distributed_embeddings_tpu.utils.metrics import StreamingAUC
 
     auc = StreamingAUC()
     state = auc.init()
     get_batch = data if callable(data) else None
-    it = iter(data) if get_batch is None else None
+    pipeline = None
+    if get_batch is None:
+        import itertools
+        from distributed_embeddings_tpu.utils.pipeline import (
+            IngestPipeline, SerialPipeline)
+        stages = ([("preprocess", preprocess)] if preprocess is not None
+                  else [])
+        # islice bounds the background read-ahead to exactly `steps`
+        # items: eval is often called repeatedly on one shared iterator
+        # (fit's eval_every loop) and must not eat batches beyond its run
+        source = itertools.islice(iter(data), steps)
+        pipeline = (IngestPipeline(source, stages) if pipelined
+                    else SerialPipeline(source, stages))
+        it = iter(pipeline)
+    else:
+        it = None
     fwd = jax.jit(lambda p, n, c: model.apply(p, n, c))
-    for step in range(steps):
-        numerical, cats, labels = (get_batch(step) if get_batch
-                                   else next(it))
-        logits = fwd(params, jnp.asarray(numerical),
-                     [jnp.asarray(c) for c in cats])
-        state = auc.update(state, jnp.asarray(labels).reshape(-1),
-                           logits.reshape(-1))
+    try:
+        for step in range(steps):
+            numerical, cats, labels = (get_batch(step) if get_batch
+                                       else next(it))
+            logits = fwd(params, jnp.asarray(numerical),
+                         [jnp.asarray(c) for c in cats])
+            state = auc.update(state, jnp.asarray(labels).reshape(-1),
+                               logits.reshape(-1))
+    finally:
+        if pipeline is not None:
+            pipeline.close()
     return float(auc.result(state))

@@ -6,6 +6,12 @@ TPU-side half of that overlap is staging the NEXT batch into device memory
 while the current step runs — jax dispatch is async, so simply keeping a
 small queue of already-device_put batches ahead of the consumer hides the
 host->HBM transfer entirely.
+
+NOTE: `stage` (and the upstream `next()`) run in the CONSUMER thread — this
+iterator hides only the host->device copy behind async dispatch, not the
+host-side read/preprocess cost. For full overlap (read, preprocess and
+staging each in their own worker thread), use `utils.pipeline.IngestPipeline`
+— `training.fit` does, by default.
 """
 
 from collections import deque
@@ -29,20 +35,35 @@ def prefetch_to_device(batches: Iterable, size: int = 2,
         inputs, or a `jax.device_put` with a NamedSharding. Defaults to
         `jax.device_put` (committed default-device placement).
 
-    Yields the staged pytrees in order.
+    Yields the staged pytrees in order. On an upstream iterator (or stage)
+    error, the batches already staged are yielded FIRST and the original
+    exception re-raises after the drain — deterministic tail behavior: no
+    staged work is silently dropped, and the consumer sees every batch that
+    preceded the failure exactly once.
     """
     stage = stage or jax.device_put
     queue: deque = deque()
     it = iter(batches)
-    try:
-        while len(queue) < size:
+    pending_exc = None
+
+    def pull() -> bool:
+        nonlocal pending_exc
+        if pending_exc is not None:
+            return False
+        try:
             queue.append(stage(next(it)))
-    except StopIteration:
+            return True
+        except StopIteration:
+            return False
+        except Exception as e:  # noqa: BLE001 - re-raised after the drain
+            pending_exc = e
+            return False
+
+    while len(queue) < size and pull():
         pass
     while queue:
         out = queue.popleft()
-        try:
-            queue.append(stage(next(it)))
-        except StopIteration:
-            pass
+        pull()
         yield out
+    if pending_exc is not None:
+        raise pending_exc

@@ -73,6 +73,12 @@ def parse_args(argv=None):
     p.add_argument("--data_parallel_threshold", type=int, default=None)
     p.add_argument("--table_scale", type=float, default=1.0,
                    help="scale Criteo vocab sizes (CPU smoke runs)")
+    p.add_argument("--serial_ingest", action="store_true",
+                   help="run read/decode/stage inline in the consumer "
+                        "thread instead of the background ingestion "
+                        "pipeline (A/B baseline)")
+    p.add_argument("--pipeline_depth", type=int, default=2,
+                   help="bound of each ingestion-pipeline queue")
     p.add_argument("--devices", type=int, default=0, help="0 = all")
     p.add_argument("--force_cpu", action="store_true",
                    help="run on virtual CPU devices (testing)")
@@ -200,18 +206,41 @@ def main(argv=None):
             start_step = last
             print(f"resumed from step {last}", flush=True)
 
-    def get_batch(i):
-        numerical, cats, labels = train_data[i % len(train_data)]
+    # ingestion pipeline: read (pread) -> preprocess (decode) -> stage
+    # (device_put) in persistent background workers so host batch prep
+    # hides under the device step (utils/pipeline.py; --serial_ingest
+    # keeps the old inline form — identical batch order)
+    from distributed_embeddings_tpu.utils.pipeline import (IngestPipeline,
+                                                           SerialPipeline)
+
+    def stage_batch(batch):
+        # per-leaf jnp.asarray, NOT jax.device_put: uncommitted placement
+        # preserves the pre-pipeline loop's behavior under a mesh (jit
+        # places inputs; a committed device-0 array would force a reshard)
+        numerical, cats, labels = batch
         return (jnp.asarray(numerical),
                 [jnp.asarray(c) for c in cats],
                 jnp.asarray(labels))
+
+    if args.data_path:
+        source = train_data.raw_batches(steps)
+        stages = [("preprocess", train_data.preprocess),
+                  ("stage", stage_batch)]
+    else:
+        source = (train_data[i % len(train_data)] for i in range(steps))
+        stages = [("stage", stage_batch)]
+    if args.serial_ingest:
+        pipe = SerialPipeline(source, stages)
+    else:
+        pipe = IngestPipeline(source, stages, depth=args.pipeline_depth)
 
     ctx = mesh or nullcontext()
     t_start = time.perf_counter()
     samples = 0
     with ctx:
+        it = iter(pipe)
         # warmup/compile on batch 0
-        numerical, cats, labels = get_batch(0)
+        numerical, cats, labels = next(it)
         params, opt_state, loss = step_fn(params, opt_state, numerical, cats,
                                           labels)
         float(loss)   # fetch = real sync (axon: block_until_ready lies)
@@ -219,7 +248,7 @@ def main(argv=None):
 
         t0 = time.perf_counter()
         for i in range(1, steps):
-            numerical, cats, labels = get_batch(i)
+            numerical, cats, labels = next(it)
             params, opt_state, loss = step_fn(params, opt_state, numerical,
                                               cats, labels)
             samples += args.batch_size
@@ -230,9 +259,15 @@ def main(argv=None):
                       f"throughput={samples / dt:,.0f} samples/s", flush=True)
         float(loss)   # fetch-sync before the throughput claim (see above)
         dt = time.perf_counter() - t0
+        pipe.close()
         if samples:
             print(f"TRAIN DONE: {samples / dt:,.0f} samples/sec "
                   f"({dt / max(steps - 1, 1) * 1e3:.2f} ms/step)", flush=True)
+        stage_ms = {k: v["mean_ms"]
+                    for k, v in pipe.stage_summaries().items()}
+        print(f"ingest stages mean ms "
+              f"({'serial' if args.serial_ingest else 'pipelined'}): "
+              f"{stage_ms}", flush=True)
 
         # ---- eval: streaming AUC over held-out batches -------------------
         metric = StreamingAUC()

@@ -82,6 +82,53 @@ def test_raw_binary_dp_batch_shard(tmp_path):
                                   cats[0][8:16].astype(np.int32))
 
 
+@pytest.mark.parametrize("use_native", [True, False])
+def test_read_raw_preprocess_split(tmp_path, use_native):
+    # the ingestion-pipeline seam: __getitem__ == preprocess(read_raw(idx)),
+    # and raw_batches() + preprocess reproduce indexed iteration exactly
+    n_rows = BATCH * N_BATCHES
+    write_split_binary(str(tmp_path), n_rows)
+
+    def make_ds():
+        return RawBinaryDataset(
+            str(tmp_path), batch_size=BATCH, numerical_features=N_NUM,
+            categorical_features=list(range(len(TABLE_SIZES))),
+            categorical_feature_sizes=TABLE_SIZES,
+            use_native_prefetch=use_native, prefetch_depth=3)
+
+    # two instances: the async prefetch window is strictly-once sequential
+    ds, ds_ref = make_ds(), make_ds()
+    for b in range(N_BATCHES):
+        num_a, cats_a, lab_a = ds.preprocess(ds.read_raw(b))
+        num_b, cats_b, lab_b = ds_ref[b]
+        np.testing.assert_array_equal(num_a, num_b)
+        np.testing.assert_array_equal(lab_a, lab_b)
+        for ca, cb in zip(cats_a, cats_b):
+            np.testing.assert_array_equal(ca, cb)
+
+
+def test_raw_batches_through_pipeline(tmp_path):
+    from distributed_embeddings_tpu.utils.pipeline import IngestPipeline
+    n_rows = BATCH * N_BATCHES
+    write_split_binary(str(tmp_path), n_rows)
+    ds = RawBinaryDataset(
+        str(tmp_path), batch_size=BATCH, numerical_features=N_NUM,
+        categorical_features=list(range(len(TABLE_SIZES))),
+        categorical_feature_sizes=TABLE_SIZES, use_native_prefetch=False)
+    # steps > len(ds): wraps like the train loop's i % len(dataset)
+    steps = N_BATCHES + 2
+    pipe = IngestPipeline(ds.raw_batches(steps),
+                          [("preprocess", ds.preprocess)])
+    out = list(pipe)
+    assert len(out) == steps
+    for i, (num, cats, lab) in enumerate(out):
+        ref_num, ref_cats, ref_lab = ds[i % N_BATCHES]
+        np.testing.assert_array_equal(num, ref_num)
+        np.testing.assert_array_equal(lab, ref_lab)
+        for ca, cb in zip(cats, ref_cats):
+            np.testing.assert_array_equal(ca, cb)
+
+
 def test_dummy_dataset_shapes():
     ds = DummyDataset(16, N_NUM, TABLE_SIZES, num_batches=2, hotness=[1, 3, 2])
     numerical, cats, labels = ds[0]

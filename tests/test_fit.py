@@ -53,6 +53,33 @@ def test_fit_sparse_and_dense_paths():
         assert steps_seen == list(range(25))
 
 
+def test_fit_pipelined_iterable_matches_serial():
+    # iterable data goes through the background ingestion pipeline by
+    # default; the losses must be bit-identical to the serial inline form
+    # (same batches, same order)
+    mesh = create_mesh(jax.devices()[:8])
+    histories = {}
+    for pipelined in (True, False):
+        model = TinyModel(SPECS, mesh)
+        rng = np.random.RandomState(0)
+        params = {
+            "embedding": model.embedding.init(jax.random.PRNGKey(0)),
+            "head": {"w": jnp.asarray(
+                rng.randn(48, 1).astype(np.float32) * 0.1)},
+        }
+        params, _, hist = training.fit(
+            model, params, (_data(i) for i in range(12)), steps=12,
+            optimizer="adagrad", lr=0.3, pipelined=pipelined,
+            log_every=0, log_fn=lambda *_: None)
+        histories[pipelined] = hist
+    np.testing.assert_array_equal(histories[True]["loss"],
+                                  histories[False]["loss"])
+    # per-stage ingestion accounting rides the history
+    stages = histories[True]["ingest_stages"]
+    assert set(stages) == {"read", "stage"}
+    assert all(v["count"] == 12 for v in stages.values())
+
+
 def test_evaluate_auc_range():
     mesh = create_mesh(jax.devices()[:8])
     model = TinyModel(SPECS, mesh)

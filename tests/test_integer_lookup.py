@@ -123,3 +123,26 @@ def test_native_parallel_large_batch_matches_sequential():
     keys = rng.randint(50_000, 120_000, size=200_000).astype(np.int64)
     np.testing.assert_array_equal(nat(keys), ref(keys))
     assert nat.size == ref.size == 50_001
+
+
+def test_native_pool_survives_fork():
+    """The persistent worker pool (PR 3) spawns detached threads that a
+    fork()ed child does not inherit; the pool must respawn its workers in
+    the child instead of waiting forever on dead ones (fork-start data
+    loaders do exactly this)."""
+    import os
+    nat = IntegerLookup(max_tokens=500_000, use_native=True)
+    if not nat.native:
+        pytest.skip("native backend unavailable")
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 400_000, size=200_000).astype(np.int64)  # pool path
+    expect = nat(keys)
+    pid = os.fork()
+    if pid == 0:
+        # child: only native-lookup work, then hard-exit (no pytest
+        # machinery, no jax) — a hang here means the pool dispatched to
+        # worker threads that do not exist in this process
+        ok = np.array_equal(nat(keys), expect)
+        os._exit(0 if ok else 1)
+    _, status = os.waitpid(pid, 0)
+    assert os.WIFEXITED(status) and os.WEXITSTATUS(status) == 0, status
