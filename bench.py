@@ -96,8 +96,9 @@ def _is_oom(e: Exception) -> bool:
                            or "Attempting to reserve" in msg)
 
 
-def run_at_batch(model, batch, iters=10, optimizer="adagrad"):
-    """Steady-state step time via a scanned multi-step program.
+def _slope_time_scan(step_fn, params, opt_state, batches, nb, iters,
+                     profile_dir=None):
+    """The scan/slope timing harness of record, shared by every bench.
 
     The whole measurement is ONE device program (lax.scan over `iters`
     steps, batches pre-staged on device), so per-dispatch tunnel latency
@@ -105,28 +106,18 @@ def run_at_batch(model, batch, iters=10, optimizer="adagrad"):
 
     Sync + timing method (round-3 hardware finding): `block_until_ready` is
     NOT a reliable sync on the axon tunnel — it returned before device work
-    finished and "measured" a step 63x faster than the HBM roofline. The sync
-    of record is a host FETCH of the summed losses (`float(jnp.sum(...))`),
-    which cannot complete before the data exists. The reported time is
-    SLOPE-BASED: the program runs once (t1) then twice back-to-back (t2);
-    per-step = (t2 - t1) / iters, cancelling constant dispatch/fetch/queue
-    overhead. Both raw timings ride along in the bench record.
+    finished and "measured" a step 63x faster than the HBM roofline. The
+    sync of record is a host FETCH of the losses, which cannot complete
+    before the data exists. The reported time is SLOPE-BASED: the program
+    runs once (t1) then twice back-to-back (t2); per-step =
+    (t2 - t1) / iters, cancelling constant dispatch/fetch/queue overhead
+    (t2 should be ~2x t1 when constant overhead is small; a large
+    deviation means the measurement is overhead- or queue-dominated).
+    Both raw timings ride along in the returned dict.
 
-    Training uses the sparse tapped path (make_sparse_train_step): dense
-    table grads for the 4.2 GiB tiny model would not fit 16G HBM and the
-    full-table adagrad pass alone (~21 GiB traffic) exceeds the entire
-    reference step budget.
+    Returns (dt_seconds, warmup_losses, {t1_ms, t2_ms, iters}). The passed
+    params/opt_state are DONATED — callers must not reuse them.
     """
-    params = model.init(jax.random.PRNGKey(0))
-    init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.01)
-    opt_state = init_fn(params)
-    gen = InputGenerator(model.config, batch, alpha=1.05, num_batches=2,
-                         seed=0)
-    batches = jax.tree.map(
-        lambda *xs: jnp.stack(xs),
-        *[(n, tuple(c), l) for (n, c, l) in gen.batches])
-    nb = len(gen)
-
     @functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3,))
     def run_steps(params, opt_state, batches, n):
         def body(carry, i):
@@ -141,16 +132,15 @@ def run_at_batch(model, batch, iters=10, optimizer="adagrad"):
         return params, opt_state, losses
 
     def fetch(losses):
-        """The real device sync: host fetch of the summed losses."""
-        s = float(jnp.sum(losses))
-        if not np.isfinite(s):
-            raise RuntimeError(f"non-finite loss in benchmark: {s}")
-        return s
+        """The real device sync: host fetch of the per-step losses."""
+        arr = np.asarray(jax.device_get(losses))
+        if not np.all(np.isfinite(arr)):
+            raise RuntimeError(f"non-finite loss in benchmark: {arr}")
+        return arr
 
     # warmup (compile) + queue drain
     params, opt_state, losses = run_steps(params, opt_state, batches, iters)
-    fetch(losses)
-    profile_dir = os.environ.get("DET_BENCH_PROFILE")
+    warm = fetch(losses)
     if profile_dir:
         from distributed_embeddings_tpu.utils import profiling
         with profiling.trace(profile_dir):
@@ -172,10 +162,31 @@ def run_at_batch(model, batch, iters=10, optimizer="adagrad"):
     t2 = time.perf_counter() - t0
 
     dt = max(t2 - t1, 1e-9) / iters
-    # sanity: t2 should be ~2x t1 when constant overhead is small; a large
-    # deviation means the measurement is overhead- or queue-dominated
-    run_at_batch.last_raw = {"t1_ms": round(t1 * 1e3, 3),
-                             "t2_ms": round(t2 * 1e3, 3), "iters": iters}
+    return dt, warm, {"t1_ms": round(t1 * 1e3, 3),
+                      "t2_ms": round(t2 * 1e3, 3), "iters": iters}
+
+
+def run_at_batch(model, batch, iters=10, optimizer="adagrad"):
+    """Steady-state step time via the shared scan/slope harness
+    (`_slope_time_scan` holds the sync + timing method of record).
+
+    Training uses the sparse tapped path (make_sparse_train_step): dense
+    table grads for the 4.2 GiB tiny model would not fit 16G HBM and the
+    full-table adagrad pass alone (~21 GiB traffic) exceeds the entire
+    reference step budget.
+    """
+    params = model.init(jax.random.PRNGKey(0))
+    init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.01)
+    opt_state = init_fn(params)
+    gen = InputGenerator(model.config, batch, alpha=1.05, num_batches=2,
+                         seed=0)
+    batches = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[(n, tuple(c), l) for (n, c, l) in gen.batches])
+    dt, _, raw = _slope_time_scan(
+        step_fn, params, opt_state, batches, len(gen), iters,
+        profile_dir=os.environ.get("DET_BENCH_PROFILE"))
+    run_at_batch.last_raw = raw
     return dt
 
 
@@ -480,6 +491,196 @@ def serve_main(argv=None) -> int:
         seed=args.seed)
     print(json.dumps(record))
     return 0 if "serve_error" not in record else 1
+
+
+def _load_hlo_audit():
+    """Load tools/hlo_audit.py by path (it is a script, not a package
+    module) — shared by the main bench's per-record audit and the hotrows
+    A/B gate."""
+    import importlib.util as _ilu
+    _sp = _ilu.spec_from_file_location(
+        "det_hlo_audit", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools",
+            "hlo_audit.py"))
+    _ha = _ilu.module_from_spec(_sp)
+    _sp.loader.exec_module(_ha)
+    return _ha
+
+
+# --------------------------------------------------------------- hotrows
+def run_hotrows_bench(vocab: int = 2_000_000, width: int = 128,
+                      batch: int = 65536, hotness: int = 1,
+                      alpha: float = 1.05, hot_rows: int = 16384,
+                      iters: int = 10, warmup_batches: int = 4,
+                      optimizer: str = "adagrad", seed: int = 0) -> dict:
+    """Hot-row replication A/B (ISSUE 4): the tapped sparse train step on
+    one zipfian single-table workload, with and without the training-side
+    hot-row shard (`DistributedEmbedding(hot_rows=...)`).
+
+    Arms share weights, data and timing method (scanned multi-step
+    program, slope-timed, loss-fetch-synced — see run_at_batch). The hot
+    arm observes `warmup_batches` batches, admits the hottest rows via
+    `sync_hot_rows(admit=True)`, then times the steady-state step; the
+    measured hot-shard hit rate of the TIMED id stream and the loss
+    deviation between arms ride in the record. Runs on any backend
+    (CPU smoke shapes via flags; perf numbers only mean something on
+    hardware)."""
+    from distributed_embeddings_tpu.layers.embedding import Embedding
+    from distributed_embeddings_tpu.layers.dist_model_parallel import (
+        DistributedEmbedding)
+
+    rng = np.random.RandomState(seed)
+
+    class _Tapped:
+        def __init__(self, hot):
+            self.embedding = DistributedEmbedding(
+                [Embedding(vocab, width, combiner="sum")], mesh=None,
+                hot_rows=hot)
+
+        def loss_fn(self, p, numerical, cats, labels, taps=None,
+                    return_residuals=False):
+            out = self.embedding(p["embedding"], list(cats), taps=taps,
+                                 return_residuals=return_residuals)
+            outs, res = out if return_residuals else (out, None)
+            x = outs[0].reshape(outs[0].shape[0], -1)
+            loss = jnp.mean((jnp.sum(x, axis=1) - labels.reshape(-1)) ** 2)
+            return (loss, res) if return_residuals else loss
+
+    def zipf_ids(n):
+        # hash-and-mod fold into the vocab (same idiom as the ingest
+        # bench's key synth / examples/criteo): clamping instead would
+        # alias the ENTIRE >= vocab tail (41-56% of draws at alpha~1.05)
+        # onto the single id vocab-1, fabricating one super-hot row and
+        # overstating the measured hit rate the A/B reports
+        z = rng.zipf(alpha, size=n).astype(np.int64)
+        return (z * 2654435761 % (1 << 40) % vocab).astype(np.int32)
+
+    nb = 2
+    data_batches = [
+        (np.zeros((batch, 1), np.float32),
+         (zipf_ids((batch, hotness)),),
+         rng.randn(batch).astype(np.float32))
+        for _ in range(nb)]
+    warm_batches = [(zipf_ids((batch, hotness)),)
+                    for _ in range(warmup_batches)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
+                           *[(jnp.asarray(n), tuple(map(jnp.asarray, c)),
+                              jnp.asarray(l))
+                             for (n, c, l) in data_batches])
+
+    def time_arm(hot, record, key):
+        model = _Tapped(hot)
+        emb = model.embedding
+        params = {"embedding": emb.init(jax.random.PRNGKey(seed))}
+        init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.01)
+        opt_state = init_fn(params)
+        hit_rate, resident = None, 0
+        if hot:
+            for (c,) in warm_batches:
+                emb.observe_hot_ids([c])
+            p, s = emb.sync_hot_rows(params["embedding"], opt_state["emb"],
+                                     admit=True)
+            params = {"embedding": p}
+            opt_state = {**opt_state, "emb": s}
+            # measured hit rate of the TIMED stream vs the admitted set
+            trs = list(emb._hot_trackers.values())
+            h0 = sum(t.hits for t in trs)
+            m0 = sum(t.misses for t in trs)
+            for (_, c, _) in data_batches:
+                emb.observe_hot_ids(list(c))
+            h1 = sum(t.hits for t in trs)
+            m1 = sum(t.misses for t in trs)
+            seen = (h1 - h0) + (m1 - m0)
+            hit_rate = round((h1 - h0) / seen, 4) if seen else 0.0
+            resident = sum(t.resident for t in trs)
+
+        dt, first_losses, raw = _slope_time_scan(
+            step_fn, params, opt_state, stacked, nb, iters)
+        record[f"{key}_ms"] = round(dt * 1e3, 3)
+        record[f"{key}_raw"] = raw
+        return dt, first_losses, hit_rate, resident, emb
+
+    record = {
+        "metric": "hotrows_zipf_train_ab",
+        "backend": jax.devices()[0].platform,
+        "hotrows_vocab": vocab, "hotrows_width": width,
+        "hotrows_batch": batch, "hotrows_hotness": hotness,
+        "hotrows_alpha": alpha, "hotrows_capacity": hot_rows,
+        "hotrows_optimizer": optimizer, "hotrows_iters": iters,
+        "git_sha": _git_sha(),
+    }
+    dt_base, losses_base, _, _, _ = time_arm(0, record, "hotrows_base")
+    dt_hot, losses_hot, hit_rate, resident, emb = time_arm(
+        hot_rows, record, "hotrows_hot")
+    record["hotrows_hit_rate"] = hit_rate
+    record["hotrows_resident"] = resident
+    # slope timing degenerates when t2-t1 is below timer noise (tiny CI
+    # shapes): a speedup computed from a clamped denominator is
+    # meaningless — report 0.0 and let the raw t1/t2 tell the story
+    reliable = dt_base > 1e-6 and dt_hot > 1e-6
+    record["hotrows_speedup"] = (round(dt_base / dt_hot, 3)
+                                 if reliable else 0.0)
+    # the arms see identical data from the same init: the warm-up-scan
+    # losses must agree to float tolerance (full parity lives in
+    # tests/test_hotrows.py; this is the bench-side sanity marker)
+    n = min(len(losses_base), len(losses_hot))
+    record["hotrows_loss_max_dev"] = float(
+        np.max(np.abs(losses_base[:n] - losses_hot[:n])))
+    rep = emb.exchange_padding_report(hotness=[hotness])
+    record["hotrows_padding_report"] = {
+        "hot_hit_ids": rep["hot_hit_ids"],
+        "true_ids_post_hot": rep["true_ids_post_hot"],
+        "hot_hit_rates": {str(k): round(v, 4)
+                          for k, v in rep["hot_hit_rates"].items()}}
+    # gate: the hot split adds ZERO sort instructions per exchange group
+    # (searchsorted membership + dense replicated update; see
+    # tools/hlo_audit.py) — lowering-only, tunnel-safe
+    try:
+        _ha = _load_hlo_audit()
+        base_a = _ha.audit_tapped_step(optimizer=optimizer, strategy="sort",
+                                       hotness=hotness, hot_rows=0)
+        hot_a = _ha.audit_tapped_step(optimizer=optimizer, strategy="sort",
+                                      hotness=hotness, hot_rows=hot_rows)
+        record["hlo_sort_audit"] = [base_a, hot_a]
+        record["hotrows_extra_sorts"] = (hot_a["hlo_sort"]
+                                         - base_a["hlo_sort"])
+    except Exception as e:  # noqa: BLE001 - audit must not kill the bench
+        record["hlo_sort_audit_error"] = str(e)[:200]
+    return record
+
+
+def hotrows_main(argv=None) -> int:
+    """`bench.py --mode hotrows` entry point: one JSON line, like main()."""
+    import argparse
+    p = argparse.ArgumentParser(description="hot-row replication benchmark")
+    p.add_argument("--mode", choices=["hotrows"], default="hotrows")
+    p.add_argument("--vocab", type=int, default=2_000_000)
+    p.add_argument("--width", type=int, default=128)
+    p.add_argument("--batch", type=int, default=65536)
+    p.add_argument("--hotness", type=int, default=1)
+    p.add_argument("--alpha", type=float, default=1.05)
+    p.add_argument("--hot_rows", type=int, default=16384)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--warmup_batches", type=int, default=4)
+    p.add_argument("--optimizer", default="adagrad",
+                   choices=["sgd", "adagrad", "adam"])
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        record = run_hotrows_bench(
+            vocab=args.vocab, width=args.width, batch=args.batch,
+            hotness=args.hotness, alpha=args.alpha, hot_rows=args.hot_rows,
+            iters=args.iters, warmup_batches=args.warmup_batches,
+            optimizer=args.optimizer, seed=args.seed)
+    except Exception as e:  # noqa: BLE001 - one JSON line, like main()
+        import traceback
+        traceback.print_exc()
+        record = {"metric": "hotrows_zipf_train_ab",
+                  "hotrows_error": str(e)[:300], "git_sha": _git_sha()}
+    print(json.dumps(record))
+    return 0 if "hotrows_error" not in record else 1
 
 
 # ---------------------------------------------------------------- ingest
@@ -970,6 +1171,13 @@ def _emit_cached_record(reason: str) -> bool:
         return False
     record["cached"] = True
     record["cached_reason"] = reason[:200]
+    # attributability (ISSUE 4 satellite): a cached replay must carry BOTH
+    # shas — the one the chip measured (git_sha, "unknown" for pre-field
+    # records like BENCH_r05's) and the HEAD that emitted the replay, so
+    # the artifact is traceable even when the measurement predates the
+    # git_sha field
+    record.setdefault("git_sha", "unknown")
+    record["cached_emitted_at_sha"] = _git_sha()
     # staleness: a cached record measured at sha X no longer describes HEAD
     # when perf-relevant files changed since (VERDICT r3 item 4)
     measured_sha = record.get("git_sha", "")
@@ -1065,13 +1273,7 @@ def main():
         # hardware can then be attributed to (or cleared of) a re-sort
         # regression from the same record
         try:
-            import importlib.util as _ilu
-            _sp = _ilu.spec_from_file_location(
-                "det_hlo_audit", os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)), "tools",
-                    "hlo_audit.py"))
-            _ha = _ilu.module_from_spec(_sp)
-            _sp.loader.exec_module(_ha)
+            _ha = _load_hlo_audit()
             record["hlo_sort_audit"] = [
                 _ha.audit_tapped_step(strategy="sort"),
                 _ha.audit_tapped_step(strategy="tiled",
@@ -1204,6 +1406,8 @@ if __name__ == "__main__":
         sys.exit(serve_main(sys.argv[1:]))
     elif _cli_mode() == "ingest":
         sys.exit(ingest_main(sys.argv[1:]))
+    elif _cli_mode() == "hotrows":
+        sys.exit(hotrows_main(sys.argv[1:]))
     elif os.environ.get("DET_BENCH_INNER") == "1":
         main()
     else:

@@ -58,6 +58,7 @@ from distributed_embeddings_tpu.ops.sparse_update import (SparseOptimizer,
 from distributed_embeddings_tpu.parallel.mesh import DEFAULT_AXIS, create_mesh
 from distributed_embeddings_tpu.parallel.planner import DistEmbeddingStrategy
 from distributed_embeddings_tpu.parallel.plan import ShardedPlan, lower_strategy
+from distributed_embeddings_tpu.utils.hotness import HotnessTracker
 from distributed_embeddings_tpu.utils.initializers import get_initializer
 
 __all__ = [
@@ -146,10 +147,18 @@ class TapResiduals:
     reuse of forward-sorted ids, embedding_lookup_kernels.cu:706-773).
     None entries (or None lists — every pre-fold producer) mean "no
     artifact"; consumers fall back to a fresh sort, so the field is
-    strictly additive."""
+    strictly additive.
+
+    `hot_pos` / `hot_w` (hot-row replication, ISSUE 4): per exchange group
+    on a hot-sharded bucket, the pre-exchange hot-membership split —
+    each lane's position in the replicated hot shard (sentinel H on miss)
+    and its effective hit weight (0 on miss). The sparse update turns
+    the hot-tap gradients into the replicated hot shard's dense row
+    update from exactly these. None on non-hot groups / pre-hot
+    residuals."""
 
     def __init__(self, key, tp_ids, tp_w, row_ids, row_w, tp_sort=None,
-                 row_sort=None):
+                 row_sort=None, hot_pos=None, hot_w=None):
         self.key = key          # static: ((k, has_w) per tp input)
         self.tp_ids = tp_ids    # per group [world, B, f_g, k_g] int32
         self.tp_w = tp_w        # per group [world, B, f_g, k_g] f32 or None
@@ -157,10 +166,13 @@ class TapResiduals:
         self.row_w = row_w      # per row input [world, B, k] f32
         self.tp_sort = tp_sort    # per group GroupSort([world, N]...) | None
         self.row_sort = row_sort  # per row input GroupSort | None
+        self.hot_pos = hot_pos  # per group [1, world, B_l, f_g, k_g] | None
+        self.hot_w = hot_w      # per group [1, world, B_l, f_g, k_g] | None
 
     def tree_flatten(self):
         return ((self.tp_ids, self.tp_w, self.row_ids, self.row_w,
-                 self.tp_sort, self.row_sort), self.key)
+                 self.tp_sort, self.row_sort, self.hot_pos, self.hot_w),
+                self.key)
 
     @classmethod
     def tree_unflatten(cls, key, children):
@@ -357,7 +369,8 @@ class DistributedEmbedding:
                  world_size: Optional[int] = None,
                  input_max_hotness: Optional[Sequence[Optional[int]]] = None,
                  use_custom_kernel: bool = True,
-                 compute_dtype: Optional[Any] = None):
+                 compute_dtype: Optional[Any] = None,
+                 hot_rows: Optional[int] = None):
         if mesh is None and world_size is not None and world_size > 1:
             mesh = create_mesh(jax.devices()[:world_size])
         self.mesh = mesh
@@ -378,6 +391,8 @@ class DistributedEmbedding:
         else:
             row_thr, dp_thr = None, None
 
+        # hot-row replication (ISSUE 4) needs the dp->mp exchange to skip:
+        # mp-input mode has no exchange, so the hot shard is dp-input only
         self.strategy = DistEmbeddingStrategy(
             embeddings, self.world_size, strategy,
             input_table_map=input_table_map,
@@ -385,7 +400,8 @@ class DistributedEmbedding:
             row_slice_threshold=row_thr,
             data_parallel_threshold=dp_thr,
             gpu_embedding_size=gpu_embedding_size,
-            input_hotness=input_max_hotness)
+            input_hotness=input_max_hotness,
+            hot_rows=(hot_rows if dp_input else 0))
 
         if self.strategy.table_groups[1]:
             if not all(self.strategy.local_configs):
@@ -464,6 +480,15 @@ class DistributedEmbedding:
         # group actually took (filled at trace time, see _use_ragged_exchange)
         self._exchange_path_taken: dict = {}
         self._host_fn_cache: dict = {}
+        # hot-row replication (ISSUE 4): buckets with a replicated hot
+        # shard, host-side frequency trackers (admission), and the jitted
+        # sync helpers. Trackers are created lazily by observe_hot_ids /
+        # sync_hot_rows; membership itself is carried in params["hot"].
+        self._hot_buckets = [b for b, bk in enumerate(self.plan.tp_buckets)
+                             if bk.hot_rows > 0]
+        self._hot_trackers: dict = {}
+        self._hot_fn_cache: dict = {}
+        self._hot_meta_cache: dict = {}
         # physical host offload: buckets past the gpu_embedding_size budget
         # live in pinned host memory (the reference's /CPU:0 placement,
         # :829-831); their lookups run in a compute_on("device_host") region
@@ -555,11 +580,42 @@ class DistributedEmbedding:
         return jax.make_array_from_single_device_arrays(
             global_shape, sharding, shards)
 
+    # -------------------------------------------------- hot-row replication
+    def _hot_sentinel(self, b: int) -> int:
+        """The membership sentinel key for bucket b: one past the flat key
+        space ``world * rows_max`` — no valid (rank, row) key reaches it,
+        and sentinel-padded slots keep the membership array sorted."""
+        return self.world_size * max(self.plan.tp_buckets[b].rows_max, 1)
+
+    def _empty_hot_entry(self, b: int) -> dict:
+        """A hot-shard param entry with an EMPTY resident set: all-sentinel
+        membership (every lookup misses — byte-identical behavior to no
+        hot shard until `sync_hot_rows` admits rows) and zero rows."""
+        bucket = self.plan.tp_buckets[b]
+        ids = jnp.full((bucket.hot_rows,), self._hot_sentinel(b), jnp.int32)
+        rows = jnp.zeros((bucket.hot_rows, bucket.width), jnp.float32)
+        if self.mesh is not None:
+            rep = NamedSharding(self.mesh, P())
+            ids = jax.device_put(ids, rep)
+            rows = jax.device_put(rows, rep)
+        return {"ids": ids, "rows": rows}
+
+    def _init_hot_params(self) -> list:
+        return [self._empty_hot_entry(b) if b in self._hot_buckets else None
+                for b in range(len(self.plan.tp_buckets))]
+
     def init(self, key) -> dict:
         """Create the parameter pytree:
           {'dp': [replicated [V,w]...],
            'tp': [stacked [world, rows_max, w] per bucket...],
            'row': [stacked [world, slice_rows_max, w] per row table...]}
+
+        Layers built with `hot_rows` add
+          {'hot': [None | {'ids': [H] int32 sorted membership keys,
+                           'rows': [H, w] replicated hot rows} per bucket]}
+        — initially EMPTY (all-sentinel membership), so the forward is
+        behaviorally identical to a hot-less layer until `sync_hot_rows`
+        admits rows.
 
         With a mesh bound, every tp/row shard is materialized per-device
         (shard-sized staging); without one, plain stacked arrays.
@@ -601,6 +657,8 @@ class DistributedEmbedding:
             for t in range(len(self.plan.row_tables)):
                 params["row"].append(jnp.stack(
                     [row_init(kr, t, r) for r in range(self.world_size)]))
+        if self._hot_buckets:
+            params["hot"] = self._init_hot_params()
         return params
 
     def param_shardings(self, mesh: Optional[Mesh] = None) -> dict:
@@ -620,11 +678,16 @@ class DistributedEmbedding:
             mk = self._bucket_memory_kind(b)
             return (NamedSharding(mesh, P(self.axis), memory_kind=mk)
                     if mk else shard0)
-        return {
+        out = {
             "dp": [rep for _ in self.strategy.dp_configs],
             "tp": [tp_shard(b) for b in range(len(self.plan.tp_buckets))],
             "row": [shard0 for _ in self.plan.row_tables],
         }
+        if self._hot_buckets:
+            out["hot"] = [({"ids": rep, "rows": rep}
+                           if b in self._hot_buckets else None)
+                          for b in range(len(self.plan.tp_buckets))]
+        return out
 
     # ----------------------------------------------------------- input prep
     def _prepare_one(self, x, max_hotness: Optional[int]) -> _PreparedInput:
@@ -721,7 +784,8 @@ class DistributedEmbedding:
         self._groups_cache[key] = res = (groups, assembly)
         return res
 
-    def exchange_padding_report(self, hotness=None) -> dict:
+    def exchange_padding_report(self, hotness=None,
+                                hot_hit_rate=None) -> dict:
         """Static accounting of the dp->mp id-exchange volume.
 
         The exchange sends one dense [world, f_max, k] id block per
@@ -736,10 +800,30 @@ class DistributedEmbedding:
                          fixed-shape lax.all_to_all moves)
           ratio          exchanged / true  (1.0 = zero padding)
 
+        Hot-row replication (ISSUE 4): groups on hot-sharded buckets gain
+
+          hot_hit_ids       expected ids served by the replicated hot
+                            shard per sample (true_ids x hit rate) —
+                            lanes that skip the exchange's useful volume
+                            (sentinel-masked, zero weight; the WIRE shape
+                            is static and unchanged: `exchanged_ids`
+                            still counts the padded wire slots)
+          true_ids_post_hot the residual USEFUL exchange volume,
+                            true_ids - hot_hit_ids
+
+        The hit rate comes from the layer's measured admission trackers
+        (`observe_hot_ids`), WINDOWED to the current residency epoch —
+        `sync_hot_rows` resets the hit/miss counters at each
+        (re-)admission so the all-miss warmup stream never dilutes the
+        rate. Pass `hot_hit_rate` (scalar or {bucket: rate}) to project
+        for an assumed rate instead.
+
         Args:
           hotness: per-tp-input hotness override; defaults to the layer's
             input_max_hotness hints (unhinted inputs count as 1).
-        Returns {"groups": [...], "true_ids", "exchanged_ids", "ratio"}.
+          hot_hit_rate: hot-shard hit-rate override (see above).
+        Returns {"groups": [...], "true_ids", "exchanged_ids", "ratio",
+        "hot_hit_ids", "true_ids_post_hot", "hot_hit_rates"}.
         """
         tp_inputs = self.strategy.input_groups[1]
         if hotness is None:
@@ -749,24 +833,45 @@ class DistributedEmbedding:
             raise ValueError(
                 f"hotness has {len(hotness)} entries, expected "
                 f"{len(tp_inputs)} (one per tp input)")
+
+        def rate_for(b):
+            if b not in self._hot_buckets:
+                return None
+            if isinstance(hot_hit_rate, dict):
+                return float(hot_hit_rate.get(b, 0.0))
+            if hot_hit_rate is not None:
+                return float(hot_hit_rate)
+            tr = self._hot_trackers.get(b)
+            return tr.hit_rate if tr is not None else 0.0
+
         key = tuple((int(h), False) for h in hotness)
         groups, _ = self._exchange_groups_for_key(key)
-        report, true_tot, ex_tot = [], 0, 0
+        report, true_tot, ex_tot, hot_tot = [], 0, 0, 0
         for g in groups:
             true_ids = sum(len(s) for s in g.rank_slots) * g.k
             ex_ids = self.world_size * g.f_max * g.k
             true_tot += true_ids
             ex_tot += ex_ids
-            report.append({
+            entry = {
                 "bucket": g.bucket, "hotness": g.k, "f_max": g.f_max,
                 "features_per_rank": [len(s) for s in g.rank_slots],
                 "true_ids": true_ids, "exchanged_ids": ex_ids,
                 "path_taken": self._exchange_path_taken.get(
                     (g.bucket, g.f_max, g.k)),
-            })
+            }
+            rate = rate_for(g.bucket)
+            if rate is not None:
+                hot_ids = int(round(true_ids * rate))
+                hot_tot += hot_ids
+                entry["hot_hit_ids"] = hot_ids
+                entry["true_ids_post_hot"] = true_ids - hot_ids
+            report.append(entry)
         return {"groups": report, "true_ids": true_tot,
                 "exchanged_ids": ex_tot,
                 "ratio": (ex_tot / true_tot) if true_tot else 1.0,
+                "hot_hit_ids": hot_tot,
+                "true_ids_post_hot": true_tot - hot_tot,
+                "hot_hit_rates": {b: rate_for(b) for b in self._hot_buckets},
                 "exchange_paths": dict(self._exchange_path_taken)}
 
     def residual_sort_scope(self, spec):
@@ -948,7 +1053,7 @@ class DistributedEmbedding:
     def _forward_local(self, dp_params, tp_params, row_params,
                        dp_in, group_ids, group_w, row_in, groups,
                        taps=None, want_res=False, sort_plan=None,
-                       row_sort_plan=None):
+                       row_sort_plan=None, hot_params=None):
         """The per-device forward (shard_map body when world > 1).
 
         Args:
@@ -1023,10 +1128,44 @@ class DistributedEmbedding:
         tp_res_ids: List[jax.Array] = []
         tp_res_w: List[Optional[jax.Array]] = []
         tp_res_sort: List[Optional[GroupSort]] = []
+        hot_res_pos: List[Optional[jax.Array]] = []
+        hot_res_w: List[Optional[jax.Array]] = []
+        hot_taps = (taps or {}).get("hot") if taps is not None else None
         for g, grp in enumerate(groups):
             ids = group_ids[g]                               # [B_l, n_g, k]
             blocal = ids.shape[0]
-            if self._use_ragged_exchange(grp, world):
+            bucket = self.plan.tp_buckets[grp.bucket]
+            offloaded = bucket.offload and self._offload_enabled
+            # hot-row replication (ISSUE 4): split the id stream against
+            # the bucket's replicated hot shard BEFORE the exchange — hit
+            # lanes are served locally from the [H, w] hot param (no
+            # all_to_all, no big-table gather); miss lanes take the stock
+            # exchange with hits masked to zero-weight id-0 lanes
+            hot = (hot_params[grp.bucket]
+                   if (hot_params is not None and bucket.hot_rows > 0
+                       and not offloaded) else None)
+            hot_info = None
+            if hot is not None:
+                send_m, w_send_m, hot_pos, hot_w = self._hot_split_send(
+                    grp, ids, group_w[g], world, blocal, hot)
+                ids_x, w_x = self._exchange_send(grp, send_m, w_send_m,
+                                                 world, blocal)
+                if w_x is None:
+                    # unweighted input: the sentinel is receiver-
+                    # detectable — real ids are < their lane's segment
+                    # rows <= rows_max and hit lanes are EXACTLY rows_max
+                    # — so the 0/scale effective weights reconstruct
+                    # locally, bit-identical to exchanging them. (An
+                    # INVALID input id == rows_max reads as weight 0 here
+                    # where the baseline clamps it onto the last row;
+                    # ids past rows_max keep the baseline clamp.)
+                    _, scale = _effective_weights(None, grp.k,
+                                                  bucket.combiner)
+                    w_x = jnp.where(
+                        ids_x == jnp.int32(max(bucket.rows_max, 1)),
+                        jnp.float32(0.0), jnp.float32(scale))
+                hot_info = (hot_pos, hot_w)
+            elif self._use_ragged_exchange(grp, world):
                 ids_x, w_x = self._ragged_id_exchange(
                     grp, ids, group_w[g], world, blocal)
             else:
@@ -1034,8 +1173,6 @@ class DistributedEmbedding:
                     grp, ids, group_w[g], world, blocal)
             offs = self._device_const(grp.offs)              # [f_max]
             ids_x = ids_x + offs[None, :, None].astype(ids_x.dtype)
-            bucket = self.plan.tp_buckets[grp.bucket]
-            offloaded = bucket.offload and self._offload_enabled
             # sort folding: ONE canonical sort of this group's exchanged id
             # stream, consumed by the tiled forward gather below (when the
             # plan says "inv") and by the sparse update via the residuals
@@ -1053,6 +1190,26 @@ class DistributedEmbedding:
                 off_ids.append(ids_x[None].astype(jnp.int32))
                 off_w.append(None if eff_w is None else eff_w[None])
                 ex_list.append(None)
+            elif hot_info is not None:
+                off_ids.append(None)
+                off_w.append(None)
+                # miss path: w_x is already the EFFECTIVE weight (scale
+                # folded, hits zeroed) — plain weighted sum, tap as usual.
+                # The gather gets sentinel lanes CLAMPED: jnp.take's
+                # default OOB mode is fill-with-NaN, and 0 * NaN = NaN —
+                # the residual/sort streams keep the raw sentinel so the
+                # update still drops those lanes outright.
+                ids_lu = jnp.minimum(ids_x, max(bucket.rows_max, 1) - 1)
+                out = self._group_lookup(tp_params[grp.bucket][0], ids_lu,
+                                         w_x, "sum", presorted=sort_g)
+                tap_g = None if taps is None else taps["tp"][g]
+                if tap_g is not None:
+                    out = out + tap_g[0].astype(out.dtype)
+                ex = self._tp_bucket_exchange(out)
+                hot_tap = None if hot_taps is None else hot_taps[g]
+                contrib = self._hot_contrib(grp, bucket, hot, hot_info[0],
+                                            hot_info[1], hot_tap)
+                ex_list.append(ex + contrib.astype(ex.dtype))
             else:
                 off_ids.append(None)
                 off_w.append(None)
@@ -1062,10 +1219,19 @@ class DistributedEmbedding:
                     presorted=sort_g)
                 ex_list.append(self._tp_bucket_exchange(out))
             if want_res:
-                eff_w, _ = _effective_weights(w_x, grp.k, bucket.combiner)
+                if hot_info is not None:
+                    # w_x IS the effective weight stream (see above)
+                    eff_w = w_x
+                else:
+                    eff_w, _ = _effective_weights(w_x, grp.k,
+                                                  bucket.combiner)
                 tp_res_ids.append(ids_x[None].astype(jnp.int32))
                 tp_res_w.append(None if eff_w is None else eff_w[None])
                 tp_res_sort.append(self._stack_sort(sort_g))
+                hot_res_pos.append(None if hot_info is None
+                                   else hot_info[0][None])
+                hot_res_w.append(None if hot_info is None
+                                 else hot_info[1][None])
 
         # ---- row-sliced tables: all_gather ids, masked lookup, psum_scatter
         row_outs, row_res = self._row_slice_local(
@@ -1073,7 +1239,8 @@ class DistributedEmbedding:
             None if taps is None else taps["row"], want_res,
             sort_plan=row_sort_plan)
         res = ((tp_res_ids, tp_res_w) + row_res[:2]
-               + (tp_res_sort, row_res[2])) if want_res else None
+               + (tp_res_sort, row_res[2])
+               + (hot_res_pos, hot_res_w)) if want_res else None
         return dp_outs, ex_list, row_outs, off_ids, off_w, res
 
     def _use_ragged_exchange(self, grp, world: int) -> bool:
@@ -1136,6 +1303,28 @@ class DistributedEmbedding:
                 w_x = w_send.reshape(-1, grp.f_max, grp.k)
         return recv.reshape(-1, grp.f_max, grp.k), w_x   # [B, f, k]
 
+    def _ragged_exchange_rows(self, grp, operand, world, blocal):
+        """One true-splits exchange of destination-major flat rows
+        ``operand [S, blocal*k]`` -> receive layout [B, f_max, k] — the
+        shared core of `_ragged_id_exchange` and the hot split's
+        `_exchange_send` (ONE copy of the split metadata, the
+        DET_RAGGED_NATIVE choice and the receive-layout reassembly, so
+        the two callers cannot drift)."""
+        me = self._my_index()
+        f_pr = jnp.asarray(grp.f_per_rank)
+        in_off = jnp.asarray(grp.in_offsets)
+        out_off = jnp.full((world,), me * grp.f_max, jnp.int32)
+        recv_sz = jnp.full((world,), jnp.take(f_pr, me), jnp.int32)
+        native_env = os.environ.get("DET_RAGGED_NATIVE", "auto")
+        native = (pallas_lookup.is_tpu_backend() if native_env == "auto"
+                  else native_env == "1")
+        out_buf = jnp.zeros((world * grp.f_max, blocal * grp.k),
+                            operand.dtype)
+        recv = _ragged_exchange_op(operand, out_buf, in_off, f_pr,
+                                   out_off, recv_sz, self.axis, native)
+        recv = recv.reshape(world, grp.f_max, blocal, grp.k)
+        return jnp.moveaxis(recv, 2, 1).reshape(-1, grp.f_max, grp.k)
+
     def _ragged_id_exchange(self, grp, ids, w, world, blocal):
         """True-splits dp->mp exchange (DET_RAGGED_EXCHANGE=1): each
         destination's features travel unpadded — sum_r f_r rows on the
@@ -1148,30 +1337,165 @@ class DistributedEmbedding:
         slots read as id/weight 0 and are never consumed downstream), so
         everything after the exchange — offsets, lookup, output exchange,
         residuals — is byte-identical to the padded path."""
-        import os
         flat_sel = jnp.asarray(grp.flat_sel)             # [S]
         s_rows = int(grp.f_per_rank.sum())
-        me = self._my_index()
-        f_pr = jnp.asarray(grp.f_per_rank)
-        in_off = jnp.asarray(grp.in_offsets)
-        out_off = jnp.full((world,), me * grp.f_max, jnp.int32)
-        recv_sz = jnp.full((world,), jnp.take(f_pr, me), jnp.int32)
-        native_env = os.environ.get("DET_RAGGED_NATIVE", "auto")
-        native = (pallas_lookup.is_tpu_backend() if native_env == "auto"
-                  else native_env == "1")
 
         def exchange(x):                                 # [B_l, n_g, k]
             send = jnp.take(x, flat_sel, axis=1)         # [B_l, S, k]
             send = jnp.moveaxis(send, 1, 0).reshape(
                 s_rows, blocal * grp.k)
-            out_buf = jnp.zeros((world * grp.f_max, blocal * grp.k),
-                                send.dtype)
-            recv = _ragged_exchange_op(send, out_buf, in_off, f_pr,
-                                       out_off, recv_sz, self.axis, native)
-            recv = recv.reshape(world, grp.f_max, blocal, grp.k)
-            return jnp.moveaxis(recv, 2, 1).reshape(-1, grp.f_max, grp.k)
+            return self._ragged_exchange_rows(grp, send, world, blocal)
 
         return exchange(ids), None if w is None else exchange(w)
+
+    # ------------------------------------------- hot-row split (ISSUE 4)
+    def _hot_group_meta(self, grp):
+        """Static per-group hot-split constants: ``base [world, f_max]``
+        — each send lane's flat key base ``rank * rows_max + row_offset``
+        — ``lane_valid [world, f_max]`` masking the f_max padding lanes
+        (their sel replicates input 0; without the mask a padding lane
+        could alias a hot key and pollute the split), and ``lane_rows
+        [world, f_max]`` — each lane's backing table-segment row count,
+        bounding which ids are in range for THAT lane (an over-range id
+        would fold onto a neighboring segment's or the next rank's key
+        space and could falsely hit a foreign resident row). Memoized per
+        group object (groups live forever in _groups_cache)."""
+        hit = self._hot_meta_cache.get(id(grp))
+        if hit is not None:
+            return hit
+        bucket = self.plan.tp_buckets[grp.bucket]
+        rows_max = max(bucket.rows_max, 1)
+        world = self.world_size
+        rows_of = {(pl.rank, pl.row_offset): pl.rows
+                   for pl in self.plan.tp_placements
+                   if pl.bucket == grp.bucket}
+        base = np.zeros((world, grp.f_max), np.int64)
+        lane_valid = np.zeros((world, grp.f_max), bool)
+        lane_rows = np.zeros((world, grp.f_max), np.int32)
+        for r in range(world):
+            base[r, :] = r * rows_max
+            for j in range(int(grp.f_per_rank[r])):
+                base[r, j] += int(grp.offs[r, j])
+                lane_valid[r, j] = True
+                lane_rows[r, j] = rows_of.get((r, int(grp.offs[r, j])), 0)
+        res = (base.astype(np.int32), lane_valid, lane_rows)
+        self._hot_meta_cache[id(grp)] = res
+        return res
+
+    def _hot_split_send(self, grp, ids, w, world, blocal, hot):
+        """Pre-exchange hot-membership split of one exchange group.
+
+        Builds the destination-major send block [world, B_l, f_max, k]
+        (ids + EFFECTIVE weights — the explicit weighted-sum form with the
+        static mean scale folded in, so hit and miss contributions share
+        the baseline's denominators), classifies every lane against the
+        bucket's sorted hot membership (`sorted_member_positions`: a
+        searchsorted — zero sort ops), and SENTINEL-masks hit lanes out
+        of the miss path: their ids go to `rows_max` (post-offset ids
+        land >= rows_max — the canonical OOB sentinel every lookup path
+        clamps and the sparse update DROPS outright) and their weights to
+        0. The canonical rows of resident ids are therefore never even
+        touched by the update — which matters for lazy adam, whose
+        moment decay runs on every *touched* row regardless of the
+        gradient value (a zero-contribution touch at a real row would
+        silently diverge its moments from the hot-less baseline).
+
+        Returns (send_ids_m, send_w_m, hot_pos, hot_w): masked send block
+        plus, per lane, the hot-shard row position (sentinel H on miss)
+        and the effective hit weight (0 on miss).
+        """
+        bucket = self.plan.tp_buckets[grp.bucket]
+        h_cap = bucket.hot_rows
+        rows_max = max(bucket.rows_max, 1)
+        eff, scale = _effective_weights(w, grp.k, bucket.combiner)
+        sel = jnp.asarray(grp.sel.reshape(-1))
+        send = jnp.take(ids, sel, axis=1).reshape(
+            blocal, world, grp.f_max, grp.k)
+        send = jnp.moveaxis(send, 1, 0).astype(jnp.int32)
+        if eff is None:
+            # unweighted input: every lane's effective weight is the
+            # static `scale`, so there is nothing worth exchanging — hit
+            # weights below are the scale constant, and the miss weights
+            # reconstruct receiver-side from the sentinel (see the
+            # caller), sparing a dense f32 all_to_all the stock
+            # unweighted exchange never pays
+            w_send = None
+        else:
+            wsum = eff * jnp.asarray(scale, jnp.float32)  # [B_l, n_g, k]
+            w_send = jnp.moveaxis(jnp.take(wsum, sel, axis=1).reshape(
+                blocal, world, grp.f_max, grp.k), 1, 0)
+        base, lane_valid, lane_rows = self._hot_group_meta(grp)
+        keys = send + jnp.asarray(base)[:, None, :, None]
+        pos, hit = embedding_ops.sorted_member_positions(hot["ids"], keys)
+        # out-of-range input ids fold onto a NEIGHBORING segment's (or the
+        # next/previous rank's) key range and could alias a resident key
+        # there — serving a foreign table's hot row with full weight where
+        # the baseline gather handles the invalid id deterministically.
+        # Invalid ids always miss: 0 <= id < this lane's segment rows.
+        hit = (hit & jnp.asarray(lane_valid)[:, None, :, None]
+               & (send >= 0)
+               & (send < jnp.asarray(lane_rows)[:, None, :, None]))
+        send_m = jnp.where(hit, jnp.int32(rows_max), send)
+        hot_pos = jnp.where(hit, pos, jnp.int32(h_cap))
+        if w_send is None:
+            w_send_m = None
+            hot_w = jnp.where(hit, jnp.float32(scale), jnp.float32(0.0))
+        else:
+            w_send_m = jnp.where(hit, 0.0, w_send)
+            hot_w = jnp.where(hit, w_send, 0.0)
+        return send_m, w_send_m, hot_pos, hot_w
+
+    def _exchange_send(self, grp, send, w_send, world, blocal):
+        """dp->mp exchange of a pre-built destination-major send block
+        [world, B_l, f_max, k] (+ weights) — the hot-split form of
+        `_padded_id_exchange` / `_ragged_id_exchange` (the split must mask
+        per (destination, slot) lane, which only exists post-`sel`).
+        Returns (ids_x [B, f, k], w_x [B, f, k]) matching the stock
+        exchanges byte for byte."""
+        if not self._use_ragged_exchange(grp, world):
+            if world > 1:
+                recv = lax.all_to_all(send, self.axis, split_axis=0,
+                                      concat_axis=0)
+                w_recv = (None if w_send is None else
+                          lax.all_to_all(w_send, self.axis, split_axis=0,
+                                         concat_axis=0))
+            else:
+                recv, w_recv = send, w_send
+            return (recv.reshape(-1, grp.f_max, grp.k),
+                    None if w_recv is None else
+                    w_recv.reshape(-1, grp.f_max, grp.k))
+        # ragged: destination-major flat rows (r, j < f_r) selected out of
+        # the send block — same operand the stock ragged path builds
+        s_rows = int(grp.f_per_rank.sum())
+        flat_rows = (np.concatenate(
+            [r * grp.f_max + np.arange(n, dtype=np.int64)
+             for r, n in enumerate(grp.f_per_rank)]).astype(np.int32)
+            if s_rows else np.zeros((0,), np.int32))
+
+        def exchange(x):                          # [world, B_l, f_max, k]
+            flat = jnp.transpose(x, (0, 2, 1, 3)).reshape(
+                world * grp.f_max, blocal * grp.k)
+            op = jnp.take(flat, jnp.asarray(flat_rows), axis=0)
+            return self._ragged_exchange_rows(grp, op, world, blocal)
+
+        return exchange(send), (None if w_send is None
+                                else exchange(w_send))
+
+    def _hot_contrib(self, grp, bucket, hot, hot_pos, hot_w, hot_tap):
+        """The hit lanes' locally-computed output contribution
+        [world, B_l, f_max, w]: gather from the replicated hot shard,
+        weighted-sum over hotness — added to the returned exchange block
+        (same layout), so hits never touch the exchange or the big table.
+        `hot_tap` (the hot-shard tap) rides the addition; its cotangent is
+        exactly the per-(serving-rank, sample, slot) output gradient the
+        replicated hot update consumes."""
+        ph = jnp.minimum(hot_pos, bucket.hot_rows - 1)
+        rows = self._cast(jnp.take(hot["rows"], ph, axis=0))
+        contrib = jnp.einsum("rbfk,rbfkw->rbfw",
+                             hot_w.astype(rows.dtype), rows)
+        if hot_tap is not None:
+            contrib = contrib + hot_tap.astype(contrib.dtype)
+        return contrib
 
     def _tp_group_out(self, tp_params, grp, ids_x, w_x, tap, presorted=None):
         """One exchange group's local bucket output [B, f, w_out], via the
@@ -1443,10 +1767,44 @@ class DistributedEmbedding:
                 "tp": [None if g in offloaded_groups else t
                        for g, t in enumerate(taps["tp"])],
                 "row": taps["row"]}
+            if "hot" in taps:
+                inner_taps["hot"] = taps["hot"]
+        hot_params = (params.get("hot")
+                      if self._hot_buckets and self.plan.tp_buckets else None)
+        # which groups take the hot split (static): mirrors _forward_local
+        hot_groups = set()
+        if hot_params is not None:
+            for g, grp in enumerate(groups):
+                if (self.plan.tp_buckets[grp.bucket].hot_rows > 0
+                        and hot_params[grp.bucket] is not None
+                        and g not in offloaded_groups):
+                    hot_groups.add(g)
+        if hot_groups and taps is not None and "hot" not in taps:
+            # the split masks resident rows' canonical gradients to ZERO
+            # by design — their updates flow only through the hot taps, so
+            # a hand-built tap pytree without them would silently freeze
+            # the hottest rows (tapless forwards are fine: no gradients)
+            raise ValueError(
+                "tapped hot-split forward needs taps['hot'] — build the "
+                "tap pytree with make_taps() (it adds the hot entry when "
+                "hot_rows is active), or pass taps=None")
         if world > 1:
             specs = lambda tree, spec: jax.tree.map(lambda _: spec, tree)
             args = (params["dp"], params["tp"], params["row"],
-                    dp_in, group_ids, group_w, row_in, inner_taps)
+                    dp_in, group_ids, group_w, row_in, inner_taps,
+                    hot_params)
+            # the hot-shard taps enter batch-sharded with the serving-rank
+            # axis intact (P(None, axis)) — each device adds the hot
+            # contribution for its OWN batch slice across all source ranks
+            tap_specs = None
+            if inner_taps is not None:
+                tap_specs = {
+                    "tp": specs(inner_taps["tp"], P(self.axis)),
+                    "row": specs(inner_taps["row"], P(self.axis))}
+                if "hot" in inner_taps:
+                    tap_specs["hot"] = [
+                        None if t is None else P(None, self.axis)
+                        for t in inner_taps["hot"]]
             in_specs = (specs(params["dp"], P()),
                         specs(params["tp"], P(self.axis)),
                         specs(params["row"], P(self.axis)),
@@ -1454,7 +1812,8 @@ class DistributedEmbedding:
                         specs(group_ids, P(self.axis)),
                         specs(group_w, P(self.axis)),
                         specs(row_in, P(self.axis)),
-                        specs(inner_taps, P(self.axis)))
+                        tap_specs,
+                        specs(hot_params, P()))
             off_id_specs = [P(self.axis) if g in offloaded_groups else None
                             for g in range(len(groups))]
             off_w_specs = [
@@ -1471,19 +1830,25 @@ class DistributedEmbedding:
             )
             res_specs = ((
                 [P(self.axis)] * len(groups),
-                [None if g is None else P(self.axis)
-                 for g in group_w],
+                # hot-split groups always carry effective weights, even
+                # when the raw input had none
+                [P(self.axis) if (w is not None or g in hot_groups)
+                 else None for g, w in enumerate(group_w)],
                 [P(self.axis)] * len(row_in),
                 [P(self.axis)] * len(row_in),
                 # GroupSort subtrees take P(axis) as a pytree-prefix spec
                 [None if p is None else P(self.axis) for p in sort_plan],
                 [None if p is None else P(self.axis)
-                 for p in row_sort_plan]) if want_res else None,)
+                 for p in row_sort_plan],
+                [P(self.axis) if g in hot_groups else None
+                 for g in range(len(groups))],
+                [P(self.axis) if g in hot_groups else None
+                 for g in range(len(groups))]) if want_res else None,)
             dp_outs, ex_list, row_outs, off_ids, off_w, res = compat.shard_map(
-                lambda d, t, r, di, gi, gw, ri, tp: self._forward_local(
+                lambda d, t, r, di, gi, gw, ri, tp, hp: self._forward_local(
                     d, t, r, di, gi, gw, ri, groups, taps=tp,
                     want_res=want_res, sort_plan=sort_plan,
-                    row_sort_plan=row_sort_plan),
+                    row_sort_plan=row_sort_plan, hot_params=hp),
                 mesh=self.mesh, in_specs=in_specs,
                 out_specs=out_specs + res_specs,
                 check_vma=False,
@@ -1494,7 +1859,8 @@ class DistributedEmbedding:
                     params["dp"], params["tp"], params["row"],
                     dp_in, group_ids, group_w, row_in, groups,
                     taps=inner_taps, want_res=want_res,
-                    sort_plan=sort_plan, row_sort_plan=row_sort_plan))
+                    sort_plan=sort_plan, row_sort_plan=row_sort_plan,
+                    hot_params=hot_params))
 
         # offloaded buckets: host-side lookup + GSPMD exchange (or the
         # scoped serving override — see offload_lookup_scope)
@@ -1526,7 +1892,7 @@ class DistributedEmbedding:
         if want_res:
             key = tuple((p.k, p.weights is not None) for p in tp_prep)
             return outputs, TapResiduals(key, res[0], res[1], res[2], res[3],
-                                         res[4], res[5])
+                                         res[4], res[5], res[6], res[7])
         return outputs
 
     def _assemble_tp_outputs(self, ex_list, tp_preps, batch, groups,
@@ -1858,6 +2224,18 @@ class DistributedEmbedding:
                          else bucket.width * grp.k)
                 taps["tp"].append(jnp.zeros(
                     (self.world_size, batch, grp.f_max, w_out), dtype))
+            if self._hot_buckets and self.dp_input:
+                # hot-shard taps (ISSUE 4): one per hot-split group, added
+                # at the hit-contribution merge — their cotangents are the
+                # per-(serving rank, sample, slot) output grads the
+                # replicated hot update consumes
+                taps["hot"] = [
+                    (jnp.zeros((self.world_size, batch, grp.f_max,
+                                self.plan.tp_buckets[grp.bucket].width),
+                               dtype)
+                     if self.plan.tp_buckets[grp.bucket].hot_rows > 0
+                     else None)
+                    for grp in groups]
         for pos, j in enumerate(strat.input_groups[2]):
             p = prepped[j]
             rt = self.plan.row_tables[strat.map_groups[2][pos]]
@@ -1948,13 +2326,23 @@ class DistributedEmbedding:
     def _sparse_update_body(self, tp_params, row_params, tp_states,
                             row_states, tp_g, row_g, res_tp_ids, res_tp_w,
                             res_row_ids, res_row_w, res_tp_sort,
-                            res_row_sort, groups, opt, dev_buckets):
+                            res_row_sort, hot_tabs, hot_states, hot_g,
+                            res_hot_pos, res_hot_w, groups, opt,
+                            dev_buckets):
         """Per-device sparse updates (stacked [1, rows, w] shards in/out).
         tp_params/tp_states hold only the non-offloaded buckets, in
         dev_buckets order. res_tp_sort / res_row_sort carry the forward's
         per-group sort artifacts (sort folding) — consumed only where a
         bucket's grad comes from a single group, so the folded update is
-        bit-identical to the fresh-sort one."""
+        bit-identical to the fresh-sort one.
+
+        hot_tabs/hot_states (ISSUE 4): the replicated [H, w] hot shards in
+        self._hot_buckets order; hot_g the hot-tap gradients and
+        res_hot_pos/res_hot_w the forward's membership split. Hot grads
+        aggregate into a dense [H, w] partial per device (H is small by
+        construction), psum to the global gradient, then apply the SAME
+        optimizer rule dense-masked (`sparse_update.apply_dense_rows`) on
+        every device — replicated in, replicated out, no sort ops."""
 
         def split_state(state):
             return tuple(x[0] if getattr(x, "ndim", 0) == 3 else x
@@ -2016,7 +2404,41 @@ class DistributedEmbedding:
                                       concat_grads(grads), **kw)
             new_row[t] = t_new[None]
             new_row_s[t] = stack_state(s_new)
-        return new_tp, new_row, new_tp_s, new_row_s
+
+        # hot shards: dense local aggregate -> psum -> replicated apply
+        new_hot_t, new_hot_s = [], []
+        hp = dict(opt.hp)
+        hot_kw = {k: hp[k] for k in ("eps", "b1", "b2") if k in hp}
+        for pos_h, b in enumerate(self._hot_buckets):
+            bucket = self.plan.tp_buckets[b]
+            h_cap, wf = bucket.hot_rows, bucket.width
+            gs = [g for g, grp in enumerate(groups)
+                  if grp.bucket == b and res_hot_pos[g] is not None
+                  and hot_g[g] is not None]
+            if not gs:
+                new_hot_t.append(hot_tabs[pos_h])
+                new_hot_s.append(hot_states[pos_h])
+                continue
+            ids_l, con_l = [], []
+            for g in gs:
+                pos = res_hot_pos[g][0]            # [world, B_l, f, k]
+                wv = res_hot_w[g][0]
+                gh = hot_g[g]                      # [world, B_l, f, wf]
+                contrib = gh[..., None, :].astype(jnp.float32) \
+                    * wv[..., None]
+                ids_l.append(pos.reshape(-1))
+                con_l.append(contrib.reshape(-1, wf))
+            g_dense, counts = sparse_update_ops._dense_sum(
+                jnp.concatenate(ids_l), jnp.concatenate(con_l), h_cap)
+            if self.world_size > 1:
+                g_dense = lax.psum(g_dense, self.axis)
+                counts = lax.psum(counts, self.axis)
+            t_new, s_new = sparse_update_ops.apply_dense_rows(
+                opt.kind, hot_tabs[pos_h], hot_states[pos_h], g_dense,
+                counts > 0, opt.lr, **hot_kw)
+            new_hot_t.append(t_new)
+            new_hot_s.append(tuple(s_new))
+        return new_tp, new_row, new_tp_s, new_row_s, new_hot_t, new_hot_s
 
     def init_sparse_state(self, params: dict, opt: SparseOptimizer) -> dict:
         """Sparse-optimizer state for the tp/row tables (dp tables train
@@ -2058,9 +2480,22 @@ class DistributedEmbedding:
             probe = jax.eval_shape(opt.init, stack)
             out_sh = tuple(shard if x.ndim == 3 else rep for x in probe)
             return jax.jit(opt.init, out_shardings=out_sh)(stack)
-        return {"tp": [init_one(t, self._bucket_memory_kind(b))
-                       for b, t in enumerate(params["tp"])],
-                "row": [init_one(t) for t in params["row"]]}
+        out = {"tp": [init_one(t, self._bucket_memory_kind(b))
+                      for b, t in enumerate(params["tp"])],
+               "row": [init_one(t) for t in params["row"]]}
+        if self._hot_buckets and "hot" in params:
+            # replicated optimizer state over the replicated hot shards
+            # (ISSUE 4): every device applies the identical (psummed)
+            # dense update, so the state never shards
+            def init_hot(entry):
+                st = opt.init(entry["rows"])
+                if self.mesh is not None:
+                    rep = NamedSharding(self.mesh, P())
+                    st = tuple(jax.device_put(x, rep) for x in st)
+                return st
+            out["hot"] = [init_hot(params["hot"][b])
+                          for b in self._hot_buckets]
+        return out
 
     def sparse_update(self, params: dict, opt_states: dict, tap_grads: dict,
                       residuals: "TapResiduals", opt: SparseOptimizer):
@@ -2094,11 +2529,25 @@ class DistributedEmbedding:
         # residual pytrees: normalize to per-entry None)
         tp_sort = residuals.tp_sort or [None] * len(residuals.tp_ids)
         row_sort = residuals.row_sort or [None] * len(residuals.row_ids)
+        # hot-shard inputs (ISSUE 4): replicated [H, w] tables/state in
+        # self._hot_buckets order; residual membership split + hot-tap
+        # grads per group (None everywhere on hot-less layers/residuals)
+        n_groups = len(residuals.tp_ids)
+        hot_on = bool(self._hot_buckets and "hot" in params
+                      and residuals.hot_pos is not None)
+        hot_tabs = ([params["hot"][b]["rows"] for b in self._hot_buckets]
+                    if hot_on else [])
+        hot_states = list(opt_states.get("hot", [])) if hot_on else []
+        hot_g = (list(tap_grads.get("hot") or [None] * n_groups)
+                 if hot_on else [None] * n_groups)
+        res_hot_pos = (residuals.hot_pos if hot_on else [None] * n_groups)
+        res_hot_w = (residuals.hot_w if hot_on else [None] * n_groups)
 
         args = (tp_dev, params["row"], tp_dev_s,
                 opt_states["row"], tap_grads["tp"], tap_grads["row"],
                 residuals.tp_ids, residuals.tp_w, residuals.row_ids,
-                residuals.row_w, tp_sort, row_sort)
+                residuals.row_w, tp_sort, row_sort,
+                hot_tabs, hot_states, hot_g, res_hot_pos, res_hot_w)
         if self.world_size > 1:
             sspec = lambda tree: jax.tree.map(self._state_spec, tree)
             pspec = lambda tree, s: jax.tree.map(lambda _: s, tree)
@@ -2112,17 +2561,26 @@ class DistributedEmbedding:
                         pspec(residuals.row_ids, P(self.axis)),
                         pspec(residuals.row_w, P(self.axis)),
                         pspec(tp_sort, P(self.axis)),
-                        pspec(row_sort, P(self.axis)))
+                        pspec(row_sort, P(self.axis)),
+                        pspec(hot_tabs, P()),
+                        sspec(hot_states),
+                        [None if g is None else P(None, self.axis)
+                         for g in hot_g],
+                        pspec(res_hot_pos, P(self.axis)),
+                        pspec(res_hot_w, P(self.axis)))
             out_specs = (pspec(tp_dev, P(self.axis)),
                          pspec(params["row"], P(self.axis)),
-                         sspec(tp_dev_s), sspec(opt_states["row"]))
-            new_tp_dev, new_row, new_tp_dev_s, new_row_s = compat.shard_map(
+                         sspec(tp_dev_s), sspec(opt_states["row"]),
+                         pspec(hot_tabs, P()), sspec(hot_states))
+            (new_tp_dev, new_row, new_tp_dev_s, new_row_s, new_hot_t,
+             new_hot_s) = compat.shard_map(
                 lambda *a: self._sparse_update_body(*a, groups, opt,
                                                     dev_buckets),
                 mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False)(*args)
         else:
-            new_tp_dev, new_row, new_tp_dev_s, new_row_s = (
+            (new_tp_dev, new_row, new_tp_dev_s, new_row_s, new_hot_t,
+             new_hot_s) = (
                 self._sparse_update_body(*args, groups, opt, dev_buckets))
 
         new_tp = list(params["tp"])
@@ -2139,7 +2597,18 @@ class DistributedEmbedding:
                                                 residuals)
                    for b in off_buckets}
         new_params = {"dp": params["dp"], "tp": new_tp, "row": new_row}
-        return new_params, {"tp": new_tp_s, "row": new_row_s}, pending
+        new_states = {"tp": new_tp_s, "row": new_row_s}
+        if "hot" in params:
+            new_hot = list(params["hot"])
+            if hot_on:
+                for pos_h, b in enumerate(self._hot_buckets):
+                    new_hot[b] = {"ids": params["hot"][b]["ids"],
+                                  "rows": new_hot_t[pos_h]}
+                new_states["hot"] = list(new_hot_s)
+            elif "hot" in opt_states:
+                new_states["hot"] = opt_states["hot"]
+            new_params["hot"] = new_hot
+        return new_params, new_states, pending
 
     def _host_bucket_pending(self, b, groups, tp_g, residuals):
         """Deduped (rep, sums) update rows for one offloaded bucket,
@@ -2472,6 +2941,319 @@ class DistributedEmbedding:
                              return_residuals=return_residuals,
                              residual_sort=residual_sort)
 
+    # ------------------------------------- hot-row admission + consistency
+    def _hot_tracker(self, b: int) -> HotnessTracker:
+        tr = self._hot_trackers.get(b)
+        if tr is None:
+            tr = HotnessTracker(self.plan.tp_buckets[b].hot_rows,
+                                promote_threshold=1)
+            self._hot_trackers[b] = tr
+        return tr
+
+    def observe_hot_ids(self, inputs) -> dict:
+        """Host-side frequency observation for hot-row admission — the
+        'warmup scan' feed (and the online counter feed between
+        `sync_hot_rows` calls). `inputs` are the SAME per-feature arrays
+        `apply` takes (dense ids, (ids, weights) tuples, RaggedIds,
+        SparseIds); observation is pure numpy on this process's view — it
+        never touches device state. Shares the counter/admission core with
+        the serving cache (`utils.hotness.HotnessTracker`).
+
+        Returns {bucket: hit_rate} of the stream observed so far against
+        each tracker's CURRENT resident set (the measured rates
+        `exchange_padding_report` folds into its post-hot accounting).
+        """
+        if not self._hot_buckets:
+            return {}
+
+        def _local_parts(arr):
+            # multi-process staged batches are global jax.Arrays that are
+            # NOT fully addressable — device_get would raise. The local
+            # batch shard is both available and exactly what this process
+            # should observe (sync_hot_rows reconciles the per-process
+            # counters by broadcasting the admitted set from process 0).
+            if getattr(arr, "is_fully_addressable", True):
+                return np.asarray(jax.device_get(arr)), 0
+            shards = sorted(arr.addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            start = shards[0].index[0].start or 0
+            return np.concatenate(
+                [np.asarray(s.data).reshape(-1) for s in shards]), start
+
+        per_bucket: dict = {b: [] for b in self._hot_buckets}
+        hot_set = set(self._hot_buckets)
+        # the device split only ever hits ids inside the lane's backing
+        # segment (`_hot_split_send` lane_rows guard) — mirror it here so
+        # an over-range id can neither inflate a NEIGHBORING segment's
+        # counts (aliased flat key) nor count as a hit the device forces
+        # to miss
+        seg_rows = {b: {(pl.rank, pl.row_offset): pl.rows
+                        for pl in self.plan.tp_placements if pl.bucket == b}
+                    for b in self._hot_buckets}
+        for pos, i in enumerate(self.strategy.input_groups[1]):
+            x = inputs[i]
+            if (isinstance(x, tuple) and len(x) == 2
+                    and not isinstance(x, RaggedIds)):
+                x = x[0]
+            if isinstance(x, RaggedIds):
+                # values past row_splits[-1] are padding by contract —
+                # counting them would attribute phantom lookups to row 0.
+                # Trim to the flat span the locally visible row_splits
+                # cover: fully-addressable, that is exactly [0, n); on a
+                # sharded batch it is always real values (padding lives
+                # past the LAST split), at worst dropping a boundary
+                # sliver of a row that straddles the shard edge — fine
+                # for frequency statistics.
+                vals, v0 = _local_parts(x.values)
+                sp, _ = _local_parts(x.row_splits)
+                sp = sp.reshape(-1)
+                lo, hi = int(sp[0]), int(sp[-1])
+                x = vals.reshape(-1)[max(lo - v0, 0):max(hi - v0, 0)]
+            elif isinstance(x, SparseIds):
+                x = x.values
+            if not isinstance(x, np.ndarray):
+                x = _local_parts(x)[0]
+            ids = x.reshape(-1).astype(np.int64)
+            for (rank, b, slot_idx) in self.plan.tp_input_slots[pos]:
+                if b not in hot_set:
+                    continue
+                bucket = self.plan.tp_buckets[b]
+                off = bucket.slots[rank][slot_idx].row_offset
+                rows = seg_rows[b].get((rank, off), 0)
+                rows_max = max(bucket.rows_max, 1)
+                v = ids[(ids >= 0) & (ids < rows)]
+                per_bucket[b].append(rank * rows_max + off + v)
+        rates = {}
+        for b, chunks in per_bucket.items():
+            if not chunks:
+                continue
+            tr = self._hot_tracker(b)
+            tr.lookup_slots(np.concatenate(chunks), observe=True)
+            rates[b] = tr.hit_rate
+        return rates
+
+    def hot_keys_from_counts(self, counts: Sequence) -> dict:
+        """Planner-driven admission input from per-input id frequencies
+        (e.g. ``IntegerLookup.counts()`` after ingestion, truncated to the
+        table's input_dim): ``counts[i]`` is a [input_dim_i] array for
+        input i, or None for unobserved inputs. Duplicate keys (shared
+        tables / column slices) aggregate. Returns {bucket: top-H keys}
+        for `sync_hot_rows(new_keys=...)`."""
+        if len(counts) != self._n_inputs:
+            raise ValueError(
+                f"counts has {len(counts)} entries, expected "
+                f"{self._n_inputs} (one per input)")
+        out = {}
+        hot_set = set(self._hot_buckets)
+        agg: dict = {b: ([], []) for b in self._hot_buckets}
+        for pos, i in enumerate(self.strategy.input_groups[1]):
+            if counts[i] is None:
+                continue
+            c = np.asarray(counts[i], np.int64).reshape(-1)
+            # clamp to the table's row count: an over-length counts array
+            # (e.g. IntegerLookup.counts() is [max_tokens + 1] — index 0
+            # is the OOV slot, so it runs one past a table with
+            # input_dim == max_tokens rows) would otherwise generate keys
+            # past the slot's rows — aliasing NEIGHBORING tables'/ranks'
+            # rows as "hot"
+            table = self.strategy.input_table_map[i]
+            in_dim = int(self.strategy.global_configs[table]["input_dim"])
+            c = c[:in_dim]
+            for (rank, b, slot_idx) in self.plan.tp_input_slots[pos]:
+                if b not in hot_set:
+                    continue
+                bucket = self.plan.tp_buckets[b]
+                off = bucket.slots[rank][slot_idx].row_offset
+                rows_max = max(bucket.rows_max, 1)
+                keys = (rank * rows_max + off
+                        + np.arange(len(c), dtype=np.int64))
+                agg[b][0].append(keys)
+                agg[b][1].append(c)
+        for b, (keys_l, counts_l) in agg.items():
+            if not keys_l:
+                continue
+            keys = np.concatenate(keys_l)
+            cnts = np.concatenate(counts_l)
+            uniq, inv = np.unique(keys, return_inverse=True)
+            tot = np.zeros(len(uniq), np.int64)
+            np.add.at(tot, inv, cnts)
+            h_cap = self.plan.tp_buckets[b].hot_rows
+            nz = tot > 0
+            order = np.argsort(-tot[nz], kind="stable")[:h_cap]
+            out[b] = uniq[nz][order]
+        return out
+
+    def _hot_fn(self, b: int, kind: str):
+        """Cached jitted scatter/gather between a stacked canonical param
+        and a [H]-keyed hot array (keys = world_slice*rows_max + row;
+        sentinel/OOB keys drop out)."""
+        key = (b, kind)
+        fn = self._hot_fn_cache.get(key)
+        if fn is not None:
+            return fn
+        rows_max = max(self.plan.tp_buckets[b].rows_max, 1)
+        world = self.world_size
+
+        def scatter(stack, keys, rows):
+            w_idx = keys // rows_max
+            r_idx = keys % rows_max
+            return stack.at[w_idx, r_idx].set(
+                rows.astype(stack.dtype), mode="drop")
+
+        def gather(stack, keys):
+            valid = (keys >= 0) & (keys < world * rows_max)
+            w_idx = jnp.clip(keys // rows_max, 0, world - 1)
+            r_idx = jnp.clip(keys % rows_max, 0, rows_max - 1)
+            picked = stack[w_idx, r_idx]
+            return jnp.where(valid[:, None], picked,
+                             jnp.zeros((), picked.dtype))
+
+        fn = jax.jit(scatter if kind == "scatter" else gather)
+        self._hot_fn_cache[key] = fn
+        return fn
+
+    def sync_hot_rows(self, params: dict, opt_states: Optional[dict] = None,
+                      new_keys: Optional[dict] = None, admit: bool = False):
+        """The hot shard's explicit consistency step (ISSUE 4).
+
+        While rows are hot-resident, the replicated hot shard (and its
+        replicated optimizer state) is AUTHORITATIVE for them — the
+        canonical MP table rows receive zero gradient (the forward masks
+        hit lanes out of the miss path). This step:
+
+          1. writes every resident hot row (and its table-shaped optimizer
+             state rows) back into the canonical stacked params, and
+          2. optionally re-admits a new hot set: ``new_keys`` maps bucket
+             -> flat row keys (``world_slice * rows_max + row``), or
+             ``admit=True`` derives them from the observed frequency
+             counters (`observe_hot_ids`); the new residents' rows AND
+             state rows gather from the (just-synced) canonical arrays, so
+             admission is numerically a no-op.
+
+        Call it before checkpointing via `save_global_weights` semantics
+        you derive from raw params, before a serving handoff, and whenever
+        re-admission should happen. (`get_weights` overlays hot rows
+        itself, so the portable dump is correct even mid-residency.)
+        Purely functional: returns ``(params, opt_states)`` new pytrees.
+        """
+        if not self._hot_buckets or "hot" not in params:
+            return params, opt_states
+        if admit and new_keys is None:
+            # each process's tracker only observed its local batch shard,
+            # so per-process top keys differ — but the membership array is
+            # consumed as a REPLICATED param, so every process must admit
+            # the identical set or the sentinel masks feeding all_to_all
+            # silently diverge. Broadcast process 0's choice (callers
+            # passing `new_keys` explicitly own that same contract).
+            new_keys = {b: tr.top_keys()
+                        for b, tr in self._hot_trackers.items()}
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                # the broadcast pytree must have IDENTICAL structure on
+                # every process, so it spans ALL hot buckets (the lazy
+                # _hot_trackers dict only holds observed ones, and which
+                # buckets were observed can differ per process); buf[0]
+                # flags whether process 0 observed the bucket — unflagged
+                # buckets drop out below and keep their current residents
+                padded = {}
+                for b in self._hot_buckets:
+                    cap = self.plan.tp_buckets[b].hot_rows
+                    buf = np.full((cap + 1,), -1, np.int64)
+                    if b in new_keys:
+                        buf[0] = 1
+                        k = np.asarray(new_keys[b],
+                                       np.int64).reshape(-1)[:cap]
+                        buf[1:1 + len(k)] = k
+                    padded[b] = buf
+                bcast = multihost_utils.broadcast_one_to_all(padded)
+                new_keys = {b: np.asarray(buf)[1:]      # -1 pads filter out
+                            for b, buf in bcast.items()
+                            if int(np.asarray(buf)[0]) == 1}
+        rep = (NamedSharding(self.mesh, P()) if self.mesh is not None
+               else None)
+
+        def _rep(x):
+            return x if rep is None else jax.device_put(x, rep)
+
+        new_params = dict(params)
+        new_params["tp"] = list(params["tp"])
+        new_params["hot"] = list(params["hot"])
+        new_states = None
+        if opt_states is not None:
+            new_states = dict(opt_states)
+            new_states["tp"] = list(opt_states["tp"])
+            new_states["hot"] = list(opt_states.get("hot", []))
+        for pos_h, b in enumerate(self._hot_buckets):
+            entry = params["hot"][b]
+            bucket = self.plan.tp_buckets[b]
+            h_cap = bucket.hot_rows
+            sent = self._hot_sentinel(b)
+            scatter = self._hot_fn(b, "scatter")
+            # 1. write-back: resident rows (+ state rows) -> canonical
+            new_params["tp"][b] = scatter(new_params["tp"][b],
+                                          entry["ids"], entry["rows"])
+            if new_states is not None and pos_h < len(new_states["hot"]):
+                can_st = list(new_states["tp"][b])
+                hot_st = list(new_states["hot"][pos_h])
+                for li, (cx, hx) in enumerate(zip(can_st, hot_st)):
+                    if getattr(cx, "ndim", 0) == 3 \
+                            and getattr(hx, "ndim", 0) == 2:
+                        can_st[li] = scatter(cx, entry["ids"], hx)
+                new_states["tp"][b] = tuple(can_st)
+            # 2. optional re-admission from the synced canonical arrays
+            if new_keys is not None and b in new_keys:
+                keys = np.asarray(new_keys[b], np.int64).reshape(-1)
+                keys = keys[(keys >= 0) & (keys < sent)]
+                # over-capacity key lists truncate in CALLER order (e.g.
+                # top_keys passes hottest first), never in numeric order —
+                # dedup keeps each key's first occurrence
+                _, first = np.unique(keys, return_index=True)
+                keys = keys[np.sort(first)][:h_cap]
+                pad = np.full((h_cap,), sent, np.int32)
+                pad[:len(keys)] = np.sort(keys).astype(np.int32)
+                # jnp.array COPIES and the block pins the transfer while
+                # `pad` is still alive: a zero-copy/async staging of the
+                # dying temp intermittently produced a membership array
+                # holding foreign bytes (observed: the int64 key buffer
+                # reinterpreted as int32 — silently wrong hits)
+                kj = _rep(jnp.array(pad))
+                kj.block_until_ready()
+                gather = self._hot_fn(b, "gather")
+                # pin the hot-shard dtype across re-admissions (a dtype
+                # flip would retrace the donated step mid-run)
+                new_params["hot"][b] = {
+                    "ids": kj,
+                    "rows": _rep(gather(new_params["tp"][b], kj)
+                                 .astype(entry["rows"].dtype))}
+                if new_states is not None \
+                        and pos_h < len(new_states["hot"]):
+                    can_st = new_states["tp"][b]
+                    hot_st = list(new_states["hot"][pos_h])
+                    for li, (cx, hx) in enumerate(zip(can_st, hot_st)):
+                        if getattr(cx, "ndim", 0) == 3 \
+                                and getattr(hx, "ndim", 0) == 2:
+                            hot_st[li] = _rep(gather(cx, kj))
+                        # scalar leaves (adam's count) keep the hot copy:
+                        # hot and canonical counts increment in lockstep
+                        # (one update each per step), and aliasing the
+                        # canonical array here would donate one buffer
+                        # twice in the next step
+                    new_states["hot"][pos_h] = tuple(hot_st)
+                # the host-side tracker mirrors the device-resident set so
+                # observed hit rates describe what the step actually hits;
+                # hit/miss stats re-window to this residency epoch (the
+                # all-miss pre-admission stream must not dilute the rates
+                # the padding report folds in)
+                tr = self._hot_tracker(b)
+                tr.set_resident(keys)
+                tr.reset_stats()
+        return new_params, new_states
+
+    def hot_stats(self) -> dict:
+        """Per-bucket admission/hit statistics of the host-side trackers
+        ({} until observe_hot_ids/sync_hot_rows have run)."""
+        return {b: tr.stats() for b, tr in self._hot_trackers.items()}
+
     # --------------------------------------------------------- weights I/O
     def _shard_host(self, arr: jax.Array, rank: int,
                     cache: Optional[dict] = None) -> np.ndarray:
@@ -2578,6 +3360,38 @@ class DistributedEmbedding:
                                       cache)[:rt.rows_per_rank[r], :]
                      for r in range(self.world_size)]
             out[gtid] = np.concatenate(parts, axis=0)
+
+        # hot-row overlay (ISSUE 4): while resident, the replicated hot
+        # shard is authoritative for its rows (the canonical table stops
+        # receiving their gradients) — merge them into the portable dump
+        # so get_weights is correct even without a prior sync_hot_rows
+        if self._hot_buckets and "hot" in params:
+            for b in self._hot_buckets:
+                entry = params["hot"][b]
+                if entry is None:
+                    continue
+                keys = np.asarray(jax.device_get(entry["ids"])) \
+                    .astype(np.int64)
+                rows = np.asarray(jax.device_get(entry["rows"]))
+                rows_max = max(self.plan.tp_buckets[b].rows_max, 1)
+                valid = (keys >= 0) & (keys < self._hot_sentinel(b))
+                if not valid.any():
+                    continue
+                w_idx = keys[valid] // rows_max
+                r_idx = keys[valid] % rows_max
+                rows_v = rows[valid]
+                for pl_ in self.plan.tp_placements:
+                    if pl_.bucket != b:
+                        continue
+                    m = ((w_idx == pl_.rank) & (r_idx >= pl_.row_offset)
+                         & (r_idx < pl_.row_offset + pl_.rows))
+                    if not m.any():
+                        continue
+                    gtid = strat.table_groups[1][pl_.table_id]
+                    if not out[gtid].flags.writeable:
+                        out[gtid] = out[gtid].copy()
+                    out[gtid][r_idx[m] - pl_.row_offset,
+                              pl_.col_start:pl_.col_end] = rows_v[m]
         return out
 
     def set_weights(self, weights: Sequence) -> dict:
@@ -2646,6 +3460,10 @@ class DistributedEmbedding:
                 new["row"].append(jnp.stack(
                     [jnp.asarray(row_shard(r, t_local, gtid))
                      for r in range(self.world_size)]))
+        if self._hot_buckets:
+            # global weights are the canonical tables; the hot set starts
+            # empty (re-admit + sync after loading to repopulate it)
+            new["hot"] = self._init_hot_params()
         return new
 
 

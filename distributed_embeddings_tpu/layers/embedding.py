@@ -235,6 +235,11 @@ class IntegerLookup:
                 out = out_u[rank][inv]
             else:
                 out = self._backend.lookup_or_insert(flat)
+        if not self.native:
+            # the dedup above hides duplicate occurrences from the numpy
+            # backend; count the full stream here (the native backend
+            # counts per occurrence inside its probe)
+            self._backend.add_counts(out)
         res = out.reshape(arr.shape)
         if isinstance(inputs, jax.Array):
             return jnp.asarray(res)
@@ -260,6 +265,17 @@ class IntegerLookup:
             host_fn, jax.ShapeDtypeStruct(inputs.shape, out_dtype), inputs,
             ordered=True)
 
+    def counts(self) -> np.ndarray:
+        """Per-index access frequencies: [capacity] int64, index 0 = OOV.
+
+        counts()[i] is how many times translated index i was produced by
+        `__call__`/`lookup_or_insert` — the natural frequency source for
+        hot-row admission (`DistributedEmbedding.hot_keys_from_counts`
+        consumes exactly this, truncated to the table's input_dim). The
+        native backend counts with relaxed atomics in its parallel probe;
+        the numpy fallback counts per batch."""
+        return self._backend.counts()
+
     def get_vocabulary(self):
         """Keys in insertion (lookup-index) order, with -1 in the OOV slot
         (reference embedding.py:255-281 returns [-1] + keys)."""
@@ -276,6 +292,7 @@ class _NumpyIntegerLookup:
     def __init__(self, capacity: int):
         self.capacity = capacity
         self._map = {}
+        self._counts = np.zeros((capacity,), np.int64)
 
     @property
     def size(self) -> int:
@@ -296,6 +313,12 @@ class _NumpyIntegerLookup:
             out[i] = idx
         return out
 
+    def add_counts(self, indices: np.ndarray) -> None:
+        """Per-OCCURRENCE frequency accounting (the class-level caller
+        passes the full pre-dedup index stream, mirroring the native
+        backend's in-probe counting)."""
+        np.add.at(self._counts, indices.reshape(-1), 1)
+
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         out = np.zeros(keys.shape, dtype=np.int64)
         m = self._map
@@ -305,3 +328,6 @@ class _NumpyIntegerLookup:
 
     def keys_in_index_order(self):
         return [k for k, _ in sorted(self._map.items(), key=lambda kv: kv[1])]
+
+    def counts(self) -> np.ndarray:
+        return self._counts.copy()

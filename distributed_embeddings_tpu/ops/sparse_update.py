@@ -447,13 +447,16 @@ def _dedup_sum_cumsum(sid, rows, is_start, sentinel, iota):
 
 def _dense_sum(ids, contribs, rows):
     """[V, w] dense aggregation: scatter-add (OOB ids dropped), plus a row
-    'touched' mask so the updater can skip untouched rows.
+    contribution COUNT so the updater can skip untouched rows (and so
+    per-device partial aggregates can be psummed before thresholding —
+    the hot-row shard's replicated update does exactly that).
 
     One WIDENED scatter carries both: each contribution row is extended
-    with a 1.0 count column, so the mask comes out of the same scatter as
+    with a 1.0 count column, so the count comes out of the same scatter as
     the data. Round-3 prims: scatter cost is per-ROW (~55-106 ns), so two
-    n-row scatters (data + bool mask) cost twice one — the fusion halves
-    the dense path's descriptor count."""
+    n-row scatters (data + count) cost twice one — the fusion halves
+    the dense path's descriptor count. Returns (g [rows, w], counts [rows]
+    f32)."""
     w = contribs.shape[-1]
     ext = jnp.concatenate(
         [contribs.astype(jnp.float32),
@@ -463,7 +466,40 @@ def _dense_sum(ids, contribs, rows):
     safe_ids = jnp.where(ids < 0, rows, ids)
     dense_ext = jnp.zeros((rows, w + 1), jnp.float32).at[safe_ids].add(
         ext, mode="drop")
-    return dense_ext[:, :w], dense_ext[:, w] > 0
+    return dense_ext[:, :w], dense_ext[:, w]
+
+
+def apply_dense_rows(kind: str, table, state, g, touched, lr, **hp):
+    """Apply a DENSE aggregated gradient `g` [rows, w] with a boolean
+    `touched` row mask to a (small) table + optimizer state — the exact
+    masked-dense rules of sparse_sgd/adagrad/adam's 'dense' strategy,
+    factored so the hot-row shard's replicated update (which must psum
+    per-device dense partials BEFORE applying) shares one set of numerics
+    with the dense aggregation strategy. Returns (table, state)."""
+    t = touched[:, None]
+    if kind == "sgd":
+        # untouched rows carry g == 0: the add is the identity there
+        return table + (-lr * g).astype(table.dtype), tuple(state)
+    if kind == "adagrad":
+        (acc,) = state
+        eps = hp.get("eps", 1e-10)
+        acc_new = acc + jnp.where(t, g * g, 0.0)
+        upd = jnp.where(t, -lr * g * lax.rsqrt(acc_new + eps), 0.0)
+        return table + upd.astype(table.dtype), (acc_new,)
+    if kind == "adam":
+        mu, nu, count = state
+        b1 = hp.get("b1", 0.9)
+        b2 = hp.get("b2", 0.999)
+        eps = hp.get("eps", 1e-8)
+        count = count + 1
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+        mu_new = jnp.where(t, b1 * mu + (1 - b1) * g, mu)
+        nu_new = jnp.where(t, b2 * nu + (1 - b2) * g * g, nu)
+        upd = jnp.where(t, -lr * (mu_new / c1)
+                        / (jnp.sqrt(nu_new / c2) + eps), 0.0)
+        return table + upd.astype(table.dtype), (mu_new, nu_new, count)
+    raise ValueError(f"Unknown sparse optimizer {kind!r}")
 
 
 def _pick(strategy: str, rows: int, width: int) -> str:
@@ -535,11 +571,10 @@ def sparse_adagrad(table: jax.Array, accum: jax.Array, grad: SparseRowGrad,
                                             else (ps.sid, ps.perm)))
     how = _pick(strategy, rows, table.shape[-1])
     if how == "dense":
-        g, touched = _dense_sum(grad.ids, grad.contribs, rows)
-        acc_new = accum + jnp.where(touched[:, None], g * g, 0.0)
-        upd = jnp.where(touched[:, None],
-                        -lr * g * lax.rsqrt(acc_new + eps), 0.0)
-        return table + upd.astype(table.dtype), acc_new
+        g, counts = _dense_sum(grad.ids, grad.contribs, rows)
+        t_new, (acc_new,) = apply_dense_rows(
+            "adagrad", table, (accum,), g, counts > 0, lr, eps=eps)
+        return t_new, acc_new
     rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows,
                           presorted=ps)
     lr_static = _static_float(lr)
@@ -584,18 +619,16 @@ def sparse_adam(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
                               lr, b1=b1, b2=b2, eps=eps,
                               presorted=(None if ps is None
                                          else (ps.sid, ps.perm)))
+    how = _pick(strategy, rows, table.shape[-1])
+    if how == "dense":
+        g, counts = _dense_sum(grad.ids, grad.contribs, rows)
+        t_new, (mu_new, nu_new, count) = apply_dense_rows(
+            "adam", table, (mu, nu, count), g, counts > 0, lr,
+            b1=b1, b2=b2, eps=eps)
+        return t_new, mu_new, nu_new, count
     count = count + 1
     c1 = 1.0 - b1 ** count.astype(jnp.float32)
     c2 = 1.0 - b2 ** count.astype(jnp.float32)
-    how = _pick(strategy, rows, table.shape[-1])
-    if how == "dense":
-        g, touched = _dense_sum(grad.ids, grad.contribs, rows)
-        t = touched[:, None]
-        mu_new = jnp.where(t, b1 * mu + (1 - b1) * g, mu)
-        nu_new = jnp.where(t, b2 * nu + (1 - b2) * g * g, nu)
-        upd = jnp.where(t, -lr * (mu_new / c1)
-                        / (jnp.sqrt(nu_new / c2) + eps), 0.0)
-        return table + upd.astype(table.dtype), mu_new, nu_new, count
     rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows,
                           presorted=ps)
     # promises per the active dedup impl (see sparse_adagrad); clamped

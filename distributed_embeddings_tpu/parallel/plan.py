@@ -65,6 +65,11 @@ class TPBucket:
     f_max: int
     # per-rank list of (table_id, row_offset, rows, initializer, dtype)
     init_segments: List[List[Tuple[int, int, int, Any, Any]]]
+    # hot-row replication capacity (ISSUE 4): the top-H hottest rows of
+    # this bucket live in a replicated [H, width] shard during training;
+    # 0 = no hot shard. Set by lower_strategy from the planner's
+    # hot_rows config, gated on eligibility (see _hot_capacity).
+    hot_rows: int = 0
     # NOTE: runtime [world, f_max] sel/offset constants live on
     # _ExchangeGroup (dist_model_parallel._exchange_groups), grouped by
     # hotness — the bucket itself carries only placement structure.
@@ -98,6 +103,33 @@ class ShardedPlan:
 def _bucket_key(config: Config) -> Tuple[int, Optional[str], bool]:
     return (config["output_dim"], config.get("combiner"),
             bool(config.get("cpu_offload", False)))
+
+
+def _hot_capacity(bucket: TPBucket, hot_rows: int, world: int) -> int:
+    """Hot-shard capacity for one bucket, 0 when ineligible.
+
+    Eligible: non-offloaded (offloaded buckets already have the serving
+    HBM cache and their updates run out-of-jit host-side), a reducing
+    combiner (the flatten path has no weighted-sum form to mask hits
+    through), and a flat key space ``world * rows_max`` that fits int32
+    (the membership searchsorted runs on int32 keys; x64 is off by
+    default on TPU, so an overflowing key space silently corrupts the
+    split — refuse instead). Capacity clamps to the bucket's true global
+    row count."""
+    if hot_rows <= 0 or bucket.offload or bucket.combiner is None:
+        return 0
+    rows_max = max(bucket.rows_max, 1)
+    # (world + 1): the forward sentinel-masks hit lanes to rows_max
+    # pre-offset, so post-offset ids reach up to 2 * rows_max on every
+    # rank — the whole value range must stay inside int32
+    if (world + 1) * rows_max + hot_rows >= 2**31 - 1:
+        import warnings
+        warnings.warn(
+            f"hot_rows disabled for a width-{bucket.width} bucket: flat "
+            f"key space world*rows_max = {world * rows_max} overflows "
+            "int32 membership keys", RuntimeWarning, stacklevel=3)
+        return 0
+    return min(hot_rows, max(sum(bucket.rows), 1))
 
 
 def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
@@ -171,6 +203,8 @@ def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
 
     for bucket in buckets:
         bucket.f_max = max((len(s) for s in bucket.slots), default=0)
+        bucket.hot_rows = _hot_capacity(
+            bucket, getattr(strategy, "hot_rows", 0), world)
 
     # ---------------- row-sliced tables -------------------------------------
     row_tables: List[RowTablePlan] = []

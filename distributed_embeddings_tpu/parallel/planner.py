@@ -18,11 +18,24 @@ Groups (reference :479-495):
 """
 
 import dataclasses
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from distributed_embeddings_tpu.utils.initializers import ConcatInitializer
 
 Config = Dict[str, Any]
+
+
+def default_hot_rows() -> int:
+    """The `DET_HOT_ROWS` environment default for hot-row replication
+    (rows per model-parallel bucket whose top-H hottest rows are
+    replicated data-parallel in the training step — see
+    layers/dist_model_parallel.py). 0 (the default) disables the hot
+    shard; an explicit ``hot_rows=`` argument always wins."""
+    try:
+        return max(0, int(os.environ.get("DET_HOT_ROWS", "0")))
+    except ValueError:
+        return 0
 
 
 def _table_size(config: Config) -> int:
@@ -52,7 +65,8 @@ class DistEmbeddingStrategy:
                  row_slice_threshold: Optional[int] = None,
                  data_parallel_threshold: Optional[int] = None,
                  gpu_embedding_size: Optional[int] = None,
-                 input_hotness: Optional[Sequence[Optional[int]]] = None):
+                 input_hotness: Optional[Sequence[Optional[int]]] = None,
+                 hot_rows: Optional[int] = None):
         if strategy not in ("auto", "basic", "memory_balanced",
                             "memory_optimized", "comm_balanced"):
             raise ValueError(f"Unsupported shard strategy {strategy}")
@@ -72,6 +86,12 @@ class DistEmbeddingStrategy:
         self.row_slice_threshold = row_slice_threshold
         self.data_parallel_threshold = data_parallel_threshold
         self.gpu_embedding_size = gpu_embedding_size
+        # hot-row replication capacity (rows per MP bucket); None defers
+        # to the DET_HOT_ROWS environment default. Eligibility per bucket
+        # (combiner, offload, key-space bounds) is decided at lowering
+        # time (parallel/plan.py lower_strategy).
+        self.hot_rows = (default_hot_rows() if hot_rows is None
+                         else max(0, int(hot_rows)))
 
         self.global_configs = []
         for emb in embeddings:

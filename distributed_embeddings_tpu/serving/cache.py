@@ -21,11 +21,14 @@ Design:
     their hit lanes' ids clamped to row 0, so a cache hit never generates
     table traffic in host memory.
   * **Host side** — the id→slot index, per-row access counters, and the
-    admission policy. Admission is counter-based: a row is promoted into a
-    free slot once its access count crosses `promote_threshold`; when the
-    cache is full, a candidate evicts the coldest resident row only if the
-    candidate's count is strictly higher. All host structures are plain
-    numpy/dicts — the cache never syncs device state to make a decision.
+    admission policy, all provided by `utils.hotness.HotnessTracker` (the
+    SAME module the training hot-row shard admits through, so serving and
+    training admission cannot drift). Admission is counter-based: a row is
+    promoted into a free slot once its access count crosses
+    `promote_threshold`; when the cache is full, a candidate evicts the
+    coldest resident row only if the candidate's count is strictly
+    higher. All host structures are plain numpy/dicts — the cache never
+    syncs device state to make a decision.
   * **Consistency** — cached rows are bit-exact copies of table rows taken
     at promotion/refresh time. The cache does NOT observe table updates:
     after a training step mutates an offloaded table, serving reads are
@@ -48,6 +51,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distributed_embeddings_tpu.ops import sparse_update as sparse_update_ops
 from distributed_embeddings_tpu.ops.embedding_ops import (
     masked_two_source_gather, miss_only_ids)
+from distributed_embeddings_tpu.utils.hotness import HotnessTracker
 
 __all__ = ["HotRowCache", "cached_group_lookup"]
 
@@ -86,24 +90,53 @@ class HotRowCache:
         self.width = bk.width
         self.rows_max = max(bk.rows_max, 1)
 
-        self._index: Dict[int, int] = {}          # row key -> slot
-        self._slot_keys = np.full((capacity,), -1, np.int64)
-        self._counts: Dict[int, int] = {}         # row key -> access count
-        # long-lived servers see unbounded unique ids: counters are pruned
-        # back to the hottest max_tracked/2 (plus residents) whenever the
-        # dict exceeds max_tracked, and promotion scans only the keys that
-        # actually crossed the threshold (`_pending`), never the full dict
-        self.max_tracked = int(max_tracked or max(64 * capacity, 4096))
-        self._pending: set = set()                # threshold-crossed keys
+        # host-side index / counters / admission policy: the shared
+        # tracker (utils/hotness.py) — long-lived servers see unbounded
+        # unique ids, so counters prune back to the hottest max_tracked/2
+        # (plus residents), and promotion scans only the threshold-crossed
+        # pending set, never the full dict
+        self._tracker = HotnessTracker(capacity,
+                                       promote_threshold=promote_threshold,
+                                       max_tracked=max_tracked)
+        self.max_tracked = self._tracker.max_tracked
         self._slots_np = np.zeros((capacity, self.width), np.float32)
         self._slots = self._put_slots()
         self._reader_cache: dict = {}
-        # stats (valid lanes only — exchange-padding lanes never count)
-        self.hits = 0
-        self.misses = 0
-        self.promotions = 0
-        self.evictions = 0
         self.refreshes = 0
+
+    # tracker views — the host-side state lives on the shared tracker;
+    # these names are the cache's public/test surface
+    @property
+    def _index(self) -> Dict[int, int]:
+        return self._tracker._index
+
+    @property
+    def _counts(self) -> Dict[int, int]:
+        return self._tracker._counts
+
+    @property
+    def _pending(self) -> set:
+        return self._tracker._pending
+
+    @property
+    def _slot_keys(self) -> np.ndarray:
+        return self._tracker.slot_keys
+
+    @property
+    def hits(self) -> int:
+        return self._tracker.hits
+
+    @property
+    def misses(self) -> int:
+        return self._tracker.misses
+
+    @property
+    def promotions(self) -> int:
+        return self._tracker.promotions
+
+    @property
+    def evictions(self) -> int:
+        return self._tracker.evictions
 
     # ------------------------------------------------------------ device IO
     def _put_slots(self):
@@ -177,91 +210,18 @@ class HotRowCache:
 
         Returns an int32 array of `keys`' shape.
         """
-        flat = np.asarray(keys, np.int64).reshape(-1)
-        vmask = (np.ones(flat.shape, bool) if valid is None
-                 else np.asarray(valid, bool).reshape(-1))
-        out = np.full(flat.shape, -1, np.int32)
-        uniq, inv, counts = np.unique(flat[vmask], return_inverse=True,
-                                      return_counts=True)
-        slot_of = np.full(uniq.shape, -1, np.int32)
-        for u, key in enumerate(uniq.tolist()):
-            s = self._index.get(key)
-            if s is not None:
-                slot_of[u] = s
-            if observe:
-                c = self._counts.get(key, 0) + int(counts[u])
-                self._counts[key] = c
-                if s is None and c >= self.promote_threshold:
-                    self._pending.add(key)
-        if observe and len(self._counts) > self.max_tracked:
-            self._prune_counts()
-        out[vmask] = slot_of[inv]
-        if observe:
-            n_hit = int((out[vmask] >= 0).sum())
-            self.hits += n_hit
-            self.misses += int(vmask.sum()) - n_hit
-        return out.reshape(np.asarray(keys).shape)
-
-    def _prune_counts(self) -> None:
-        """Bound the counter dict: keep resident keys plus the hottest
-        half of max_tracked; everything colder restarts from zero if seen
-        again (an admissible information loss — a pruned key was, by
-        construction, colder than max_tracked/2 other keys)."""
-        resident = set(self._index)
-        keep_n = self.max_tracked // 2
-        hottest = sorted(self._counts.items(), key=lambda kv: -kv[1])[:keep_n]
-        kept = {k: c for k, c in hottest}
-        for k in resident:
-            if k in self._counts:
-                kept[k] = self._counts[k]
-        self._counts = kept
-        self._pending &= set(kept)
-
-    def _promotion_candidates(self):
-        """Uncached keys whose count crossed the threshold, hottest first —
-        drawn from the `_pending` set, not a full counter scan."""
-        self._pending -= set(self._index)
-        cands = [(self._counts.get(k, 0), k) for k in self._pending]
-        cands.sort(reverse=True)
-        return cands
+        return self._tracker.lookup_slots(keys, valid=valid, observe=observe)
 
     def admit(self, table: jax.Array) -> int:
         """Run the admission policy against the current counters, copying
         newly-promoted rows out of `table`. Returns rows promoted."""
-        cands = self._promotion_candidates()
-        if not cands:
-            return 0
-        free = [s for s in range(self.capacity) if self._slot_keys[s] < 0]
-        plan = []                                  # (slot, key)
-        for count, key in cands:
-            if free:
-                slot = free.pop()
-            else:
-                # full: evict the coldest resident only for a strictly
-                # hotter row. Slots planned earlier this round already
-                # carry their NEW key (assigned below), so the scan ranks
-                # them by the newcomer's count, never as empty.
-                coldest = min(range(self.capacity),
-                              key=lambda s: self._counts.get(
-                                  int(self._slot_keys[s]), 0))
-                cold_key = int(self._slot_keys[coldest])
-                if count <= self._counts.get(cold_key, 0):
-                    break                          # sorted: nothing hotter left
-                self._index.pop(cold_key, None)
-                self.evictions += 1
-                slot = coldest
-            self._slot_keys[slot] = key
-            plan.append((slot, key))
+        plan = self._tracker.plan_admissions()
         if not plan:
             return 0
         keys = np.asarray([k for _, k in plan], np.int64)
         rows = self._read_rows(table, keys)
         self._update_slots(np.asarray([s for s, _ in plan]), rows)
-        for slot, key in plan:
-            self._index[key] = slot
-            self._pending.discard(key)
-        self.promotions += len(plan)
-        return len(plan)
+        return self._tracker.commit_admissions(plan)
 
     def refresh(self, table: jax.Array) -> int:
         """Re-copy every resident row from `table` into the HBM slots —
@@ -276,17 +236,12 @@ class HotRowCache:
 
     def invalidate(self) -> None:
         """Drop every resident row (hits resume only after re-admission)."""
-        for k in self._index:
-            if self._counts.get(k, 0) >= self.promote_threshold:
-                self._pending.add(k)       # still hot: re-promotable
-        self._index.clear()
-        self._slot_keys.fill(-1)
+        self._tracker.invalidate()
 
     # ---------------------------------------------------------------- stats
     @property
     def hit_rate(self) -> float:
-        total = self.hits + self.misses
-        return self.hits / total if total else 0.0
+        return self._tracker.hit_rate
 
     def stats(self) -> dict:
         return {"bucket": self.bucket, "capacity": self.capacity,

@@ -324,7 +324,7 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         callbacks=(), eval_data=None, eval_every: int = 0,
         eval_steps: int = 16, log_every: int = 100, log_fn=print,
         stage=None, sync_every=None, preprocess=None, pipelined: bool = True,
-        pipeline_depth: int = 2):
+        pipeline_depth: int = 2, hot_sync_every: int = 0):
     """Minimal training-loop driver — the role the reference fills with
     Keras `model.fit` + `DistributedOptimizer` + callbacks
     (reference dist_model_parallel.py:1270-1326, synthetic main.py:104-114).
@@ -369,6 +369,18 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         and on the CPU backend (XLA:CPU's in-process collectives can
         deadlock when many steps are dispatched asynchronously), else 0
         (TPU: never block mid-run).
+      hot_sync_every: hot-row replication cadence (layers built with
+        `hot_rows=`, sparse path only): every N steps the loop runs
+        `sync_hot_rows(admit=True)` — write hot rows back to the
+        canonical tables and re-admit the currently-hottest set. The
+        frequency feed (`observe_hot_ids` — host-side numpy counter
+        work) is SAMPLED, not per-step: ~8 observed batches per sync
+        window (`max(1, N // 8)` stride), because the per-unique-key
+        counter update is real host time and zipfian admission only
+        needs a frequency ESTIMATE — per-step observation would
+        serialize exactly the class of host work the ingest pipeline
+        exists to hide. 0 (default) leaves admission entirely to the
+        caller.
 
     Returns (params, opt_state, history) — history is a dict of lists
     ('loss' as floats, drained from device at sync/log boundaries;
@@ -434,10 +446,23 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         history["loss"].extend(float(l) for l in jax.device_get(pending))
         pending.clear()
 
+    hot_emb = getattr(model, "embedding", None)
+    hot_active = (sparse and hot_sync_every
+                  and getattr(hot_emb, "_hot_buckets", None))
+    hot_observe_stride = max(1, hot_sync_every // 8) if hot_active else 0
     try:
         for step in range(steps):
             batch = get_batch(step) if get_batch else next(it)
             numerical, cats, labels = batch
+            if hot_active:
+                if step % hot_observe_stride == 0:
+                    hot_emb.observe_hot_ids(list(cats))
+                if step and step % hot_sync_every == 0:
+                    drain()     # params are about to be rewritten: sync
+                    p_emb, s_emb = hot_emb.sync_hot_rows(
+                        params["embedding"], opt_state["emb"], admit=True)
+                    params = {**params, "embedding": p_emb}
+                    opt_state = {**opt_state, "emb": s_emb}
             params, opt_state, loss = step_fn(params, opt_state,
                                               jnp.asarray(numerical),
                                               [jnp.asarray(c) for c in cats],
@@ -465,6 +490,15 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
             history["ingest_stages"] = pipeline.stage_summaries()
             pipeline.close()
     drain()
+    if hot_active:
+        # leave the returned params canonical-consistent (hot rows written
+        # back; residency unchanged) so raw-param consumers need no extra
+        # sync — a numeric no-op for the training state itself
+        p_emb, s_emb = hot_emb.sync_hot_rows(params["embedding"],
+                                             opt_state["emb"])
+        params = {**params, "embedding": p_emb}
+        opt_state = {**opt_state, "emb": s_emb}
+        history["hot_stats"] = hot_emb.hot_stats()
     return params, opt_state, history
 
 

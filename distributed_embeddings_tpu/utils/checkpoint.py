@@ -15,6 +15,24 @@ get_weights()/set_weights() global resharding round-trip over collectives
     ``DistributedEmbedding.get_weights`` and consumed by ``set_weights``
     (which accepts mmap'd file paths for larger-than-memory loads,
     reference :911-950). Survives topology changes.
+
+Hot-row replication (ISSUE 4): layers built with ``hot_rows=`` carry a
+replicated hot shard in ``params["hot"]`` that is AUTHORITATIVE for its
+resident rows (the canonical tables stop receiving their gradients).
+Both checkpoint layers stay correct:
+
+  * the Orbax path saves/restores ``params["hot"]`` (membership + rows)
+    as ordinary pytree leaves, so a same-topology resume continues with
+    the hot set intact;
+  * the portable path is already merged — ``get_weights`` overlays the
+    resident hot rows onto the canonical tables — and ``set_weights``
+    restarts with an EMPTY hot set (re-admit via
+    ``sync_hot_rows(admit=True)`` after loading).
+
+To hand raw ``params["tp"]`` arrays to anything else (serving handoff,
+external dumps), run ``DistributedEmbedding.sync_hot_rows`` first — that
+is the explicit consistency step that writes hot rows (and their
+optimizer-state rows) back into the canonical tables.
 """
 
 import os

@@ -1,0 +1,237 @@
+"""Counter-based hot-row admission, shared by serving and training.
+
+Production recommender traffic is power-law distributed: a small set of
+hot rows absorbs most lookups. Two subsystems exploit that skew with the
+SAME admission policy and must not drift:
+
+  * the serving HBM hot-row cache (`serving/cache.py` `HotRowCache`) —
+    hot rows of a host-offloaded bucket are served from device memory;
+  * the training hot-row shard (`layers/dist_model_parallel.py`,
+    `DistributedEmbedding(hot_rows=...)`) — hot rows of a model-parallel
+    bucket are replicated data-parallel so hits skip the id exchange and
+    the table-scale gather/scatter.
+
+`HotnessTracker` is the factored host-side core both use: per-row access
+counters, a bounded-memory pruning rule, a pending set of
+threshold-crossers, a fixed-capacity resident set (key -> slot), and the
+admission/eviction policy. It never touches device state — callers copy
+rows around; the tracker only decides WHICH rows are hot.
+
+Rows are keyed by an opaque non-negative integer (the stacked-bucket
+``world_slice * rows_max + local_row`` flat key in both current callers).
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["HotnessTracker"]
+
+
+class HotnessTracker:
+    """Access counters + admission policy over a fixed-capacity hot set.
+
+    Args:
+      capacity: number of resident slots (static).
+      promote_threshold: access count at which a row becomes
+        promotion-eligible (>= 1; 1 promotes on first touch).
+      max_tracked: bound on the counter dict; beyond it, counters prune
+        back to the hottest max_tracked/2 keys (plus residents). Default
+        max(64 * capacity, 4096).
+    """
+
+    def __init__(self, capacity: int, promote_threshold: int = 2,
+                 max_tracked: Optional[int] = None):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        if promote_threshold < 1:
+            raise ValueError("promote_threshold must be >= 1")
+        self.capacity = int(capacity)
+        self.promote_threshold = int(promote_threshold)
+        self.max_tracked = int(max_tracked or max(64 * capacity, 4096))
+        self._index: Dict[int, int] = {}          # row key -> slot
+        self.slot_keys = np.full((self.capacity,), -1, np.int64)
+        self._counts: Dict[int, int] = {}         # row key -> access count
+        self._pending: set = set()                # threshold-crossed keys
+        # stats (valid lanes only — callers mask padding before observing)
+        self.hits = 0
+        self.misses = 0
+        self.promotions = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------- observe
+    def lookup_slots(self, keys: np.ndarray,
+                     valid: Optional[np.ndarray] = None,
+                     observe: bool = True) -> np.ndarray:
+        """Map row keys to resident slots: >= 0 on hit, -1 on miss.
+
+        Args:
+          keys: integer array (any shape) of row keys.
+          valid: optional same-shape bool mask; invalid lanes (exchange
+            padding) always map to -1 and never touch counters or stats.
+          observe: update access counters + hit/miss stats (warmup passes
+            set False so compile-ahead does not skew admission).
+
+        Returns an int32 array of `keys`' shape.
+        """
+        flat = np.asarray(keys, np.int64).reshape(-1)
+        vmask = (np.ones(flat.shape, bool) if valid is None
+                 else np.asarray(valid, bool).reshape(-1))
+        out = np.full(flat.shape, -1, np.int32)
+        uniq, inv, counts = np.unique(flat[vmask], return_inverse=True,
+                                      return_counts=True)
+        slot_of = np.full(uniq.shape, -1, np.int32)
+        for u, key in enumerate(uniq.tolist()):
+            s = self._index.get(key)
+            if s is not None:
+                slot_of[u] = s
+            if observe:
+                c = self._counts.get(key, 0) + int(counts[u])
+                self._counts[key] = c
+                if s is None and c >= self.promote_threshold:
+                    self._pending.add(key)
+        if observe and len(self._counts) > self.max_tracked:
+            self._prune_counts()
+        out[vmask] = slot_of[inv]
+        if observe:
+            n_hit = int((out[vmask] >= 0).sum())
+            self.hits += n_hit
+            self.misses += int(vmask.sum()) - n_hit
+        return out.reshape(np.asarray(keys).shape)
+
+    def observe(self, keys: np.ndarray,
+                valid: Optional[np.ndarray] = None) -> None:
+        """Count-only observation (the training warmup scan's form)."""
+        self.lookup_slots(keys, valid=valid, observe=True)
+
+    def _prune_counts(self) -> None:
+        """Bound the counter dict: keep resident keys plus the hottest
+        half of max_tracked; everything colder restarts from zero if seen
+        again (an admissible information loss — a pruned key was, by
+        construction, colder than max_tracked/2 other keys)."""
+        resident = set(self._index)
+        keep_n = self.max_tracked // 2
+        hottest = sorted(self._counts.items(), key=lambda kv: -kv[1])[:keep_n]
+        kept = {k: c for k, c in hottest}
+        for k in resident:
+            if k in self._counts:
+                kept[k] = self._counts[k]
+        self._counts = kept
+        self._pending &= set(kept)
+
+    # ----------------------------------------------------------- admission
+    def _promotion_candidates(self) -> List[Tuple[int, int]]:
+        """Uncached keys whose count crossed the threshold, hottest first —
+        drawn from the `_pending` set, not a full counter scan."""
+        self._pending -= set(self._index)
+        cands = [(self._counts.get(k, 0), k) for k in self._pending]
+        cands.sort(reverse=True)
+        return cands
+
+    def plan_admissions(self) -> List[Tuple[int, int]]:
+        """Run the admission policy against the current counters.
+
+        Returns the (slot, key) assignment plan, hottest first. Free slots
+        fill first; when full, a candidate evicts the coldest resident row
+        only if the candidate's count is strictly higher. The plan updates
+        `slot_keys` (and pops evicted keys from the index, counting
+        `evictions`) immediately so a second plan in the same round sees
+        the new occupancy; callers copy the planned rows, then call
+        `commit_admissions(plan)` to make them resident.
+        """
+        cands = self._promotion_candidates()
+        if not cands:
+            return []
+        free = [s for s in range(self.capacity) if self.slot_keys[s] < 0]
+        plan: List[Tuple[int, int]] = []
+        for count, key in cands:
+            if free:
+                slot = free.pop()
+            else:
+                # full: evict the coldest resident only for a strictly
+                # hotter row. Slots planned earlier this round already
+                # carry their NEW key, so the scan ranks them by the
+                # newcomer's count, never as empty.
+                coldest = min(range(self.capacity),
+                              key=lambda s: self._counts.get(
+                                  int(self.slot_keys[s]), 0))
+                cold_key = int(self.slot_keys[coldest])
+                if count <= self._counts.get(cold_key, 0):
+                    break                          # sorted: nothing hotter left
+                self._index.pop(cold_key, None)
+                self.evictions += 1
+                slot = coldest
+            self.slot_keys[slot] = key
+            plan.append((slot, key))
+        return plan
+
+    def commit_admissions(self, plan: List[Tuple[int, int]]) -> int:
+        """Make a `plan_admissions` plan resident (caller copied the rows).
+        Returns rows promoted."""
+        for slot, key in plan:
+            self._index[key] = slot
+            self._pending.discard(key)
+        self.promotions += len(plan)
+        return len(plan)
+
+    def set_resident(self, keys: np.ndarray) -> None:
+        """Replace the resident set wholesale (planner-driven admission,
+        e.g. top-H from IntegerLookup counts): key i occupies slot i.
+        Evicted keys are not counted as evictions — this is a reset, not
+        the online policy."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        if len(keys) > self.capacity:
+            raise ValueError(
+                f"{len(keys)} keys exceed capacity {self.capacity}")
+        if len(np.unique(keys)) != len(keys):
+            raise ValueError("resident keys must be unique")
+        self._index = {int(k): i for i, k in enumerate(keys.tolist())}
+        self.slot_keys.fill(-1)
+        self.slot_keys[:len(keys)] = keys
+        self._pending -= set(self._index)
+
+    def invalidate(self) -> None:
+        """Drop every resident row (hits resume only after re-admission)."""
+        for k in self._index:
+            if self._counts.get(k, 0) >= self.promote_threshold:
+                self._pending.add(k)       # still hot: re-promotable
+        self._index.clear()
+        self.slot_keys.fill(-1)
+
+    def resident_keys(self) -> np.ndarray:
+        """Current resident keys ([R] int64, slot order, R <= capacity)."""
+        return self.slot_keys[self.slot_keys >= 0].copy()
+
+    def top_keys(self, n: Optional[int] = None) -> np.ndarray:
+        """The hottest n tracked keys by count (default: capacity) —
+        the 'warmup scan' admission input: observe batches, then
+        ``set_resident(top_keys())``."""
+        n = self.capacity if n is None else int(n)
+        items = sorted(self._counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        return np.asarray([k for k, _ in items[:n]], np.int64)
+
+    # ---------------------------------------------------------------- stats
+    def reset_stats(self) -> None:
+        """Zero the hit/miss counters (NOT the frequency counters or the
+        resident set) — callers window measured hit rates to a residency
+        epoch, e.g. the training hot shard resets at each re-admission so
+        reported rates describe the CURRENT hot set, not the all-miss
+        warmup stream."""
+        self.hits = 0
+        self.misses = 0
+
+    @property
+    def resident(self) -> int:
+        return int((self.slot_keys >= 0).sum())
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> dict:
+        return {"capacity": self.capacity, "resident": self.resident,
+                "hits": self.hits, "misses": self.misses,
+                "hit_rate": round(self.hit_rate, 4),
+                "promotions": self.promotions, "evictions": self.evictions,
+                "tracked": len(self._counts), "pending": len(self._pending)}

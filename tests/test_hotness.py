@@ -1,0 +1,102 @@
+"""Unit tests for the shared counter-based admission core
+(utils/hotness.py, ISSUE 4 satellite): serving's HBM cache and the
+training hot-row shard admit through this one module, so its policy —
+threshold promotion, strictly-hotter eviction, bounded counters,
+resident-set resets — is pinned here once for both."""
+
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.utils.hotness import HotnessTracker
+
+
+def test_lookup_slots_miss_then_hit_and_stats():
+    tr = HotnessTracker(capacity=4, promote_threshold=2)
+    keys = np.array([5, 5, 7])
+    out = tr.lookup_slots(keys)
+    assert (out == -1).all()
+    assert tr.misses == 3 and tr.hits == 0
+    plan = tr.plan_admissions()            # key 5 crossed threshold (2)
+    assert [k for _, k in plan] == [5]
+    assert tr.commit_admissions(plan) == 1
+    out = tr.lookup_slots(keys)
+    assert (out[:2] >= 0).all() and out[2] == -1
+    assert tr.hits == 2
+    assert tr.stats()["resident"] == 1
+
+
+def test_valid_mask_excludes_padding_lanes():
+    tr = HotnessTracker(capacity=2, promote_threshold=1)
+    keys = np.array([[1, 2], [3, 4]])
+    valid = np.array([[True, False], [True, False]])
+    out = tr.lookup_slots(keys, valid=valid)
+    assert out.shape == keys.shape
+    assert (out[:, 1] == -1).all()
+    # invalid lanes never touched counters or stats
+    assert set(tr._counts) == {1, 3}
+    assert tr.hits + tr.misses == 2
+
+
+def test_eviction_only_for_strictly_hotter():
+    tr = HotnessTracker(capacity=1, promote_threshold=1)
+    tr.observe(np.array([10, 10]))
+    tr.commit_admissions(tr.plan_admissions())
+    assert tr.resident_keys().tolist() == [10]
+    # equally-hot candidate must NOT evict
+    tr.observe(np.array([11, 11]))
+    assert tr.plan_admissions() == []
+    assert tr.evictions == 0
+    # strictly hotter candidate evicts the coldest resident
+    tr.observe(np.array([11]))
+    plan = tr.plan_admissions()
+    assert [k for _, k in plan] == [11]
+    tr.commit_admissions(plan)
+    assert tr.evictions == 1
+    assert tr.resident_keys().tolist() == [11]
+
+
+def test_prune_keeps_residents_and_hottest():
+    tr = HotnessTracker(capacity=2, promote_threshold=1, max_tracked=8)
+    hot = np.repeat(np.array([100, 101]), 5)
+    tr.observe(hot)
+    tr.commit_admissions(tr.plan_admissions())
+    tr.observe(np.arange(20))              # flood of cold singletons
+    assert len(tr._counts) <= 8
+    assert {100, 101} <= set(tr._counts)   # residents survive pruning
+
+
+def test_set_resident_and_top_keys():
+    tr = HotnessTracker(capacity=3, promote_threshold=1)
+    tr.observe(np.array([1, 1, 1, 2, 2, 3, 4]))
+    top = tr.top_keys(2)
+    assert top.tolist() == [1, 2]
+    tr.set_resident(top)
+    assert sorted(tr.resident_keys().tolist()) == [1, 2]
+    out = tr.lookup_slots(np.array([1, 2, 3]), observe=False)
+    assert (out[:2] >= 0).all() and out[2] == -1
+    with pytest.raises(ValueError):
+        tr.set_resident(np.array([1, 1]))  # duplicates rejected
+    with pytest.raises(ValueError):
+        tr.set_resident(np.arange(4))      # over capacity
+
+
+def test_invalidate_reenters_pending():
+    tr = HotnessTracker(capacity=2, promote_threshold=2)
+    tr.observe(np.array([9, 9]))
+    tr.commit_admissions(tr.plan_admissions())
+    tr.invalidate()
+    assert tr.resident == 0
+    plan = tr.plan_admissions()            # still hot: re-promotable
+    assert [k for _, k in plan] == [9]
+
+
+def test_serving_cache_delegates_to_tracker():
+    """The cache's host-side surface IS the tracker (no drift possible):
+    its dict/array views alias the tracker's own state."""
+    from distributed_embeddings_tpu.serving.cache import HotRowCache
+
+    assert HotRowCache._index.fget is not None   # property, not a dict
+    # the tracker type is shared, not a reimplementation
+    import inspect
+    src = inspect.getsource(HotRowCache.admit)
+    assert "plan_admissions" in src and "commit_admissions" in src

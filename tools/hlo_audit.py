@@ -28,7 +28,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _build_model(vocab: int, width: int, combiner: str):
+def _build_model(vocab: int, width: int, combiner: str, hot_rows: int = 0):
     import jax.numpy as jnp
     from distributed_embeddings_tpu.layers.dist_model_parallel import (
         DistributedEmbedding)
@@ -51,7 +51,7 @@ def _build_model(vocab: int, width: int, combiner: str):
             return (loss, res) if return_residuals else loss
 
     emb = DistributedEmbedding([Embedding(vocab, width, combiner=combiner)],
-                               mesh=None)
+                               mesh=None, hot_rows=hot_rows)
     return _Tapped(emb)
 
 
@@ -59,10 +59,16 @@ def audit_tapped_step(vocab: int = 30_000_000, width: int = 8,
                       batch: int = 8, hotness: int = 4,
                       optimizer: str = "adagrad", strategy: str = "sort",
                       lookup_path: str = None, fold: bool = True,
-                      combiner: str = "sum") -> dict:
+                      combiner: str = "sum", hot_rows: int = 0) -> dict:
     """Lower one tapped sparse train step (abstract avals — no giant table
     is materialized) and count its StableHLO ops. Returns the counts plus
-    the exchange-group count the sort bound is measured against."""
+    the exchange-group count the sort bound is measured against.
+
+    ``hot_rows > 0`` lowers the hot-row-replication step (ISSUE 4): the
+    membership split is a searchsorted (binary search) and the replicated
+    hot update is a dense scatter — the sort BOUND is identical to the
+    hot-less step, which is exactly the acceptance gate ("the hot split
+    adds zero sort instructions per exchange group")."""
     import jax
     import jax.numpy as jnp
     from distributed_embeddings_tpu.training import make_sparse_train_step
@@ -74,7 +80,7 @@ def audit_tapped_step(vocab: int = 30_000_000, width: int = 8,
             os.environ.pop("DET_LOOKUP_PATH", None)
         else:
             os.environ["DET_LOOKUP_PATH"] = lookup_path
-        model = _build_model(vocab, width, combiner)
+        model = _build_model(vocab, width, combiner, hot_rows=hot_rows)
         emb = model.embedding
         init_fn, step_fn = make_sparse_train_step(
             model, optimizer, lr=0.01, strategy=strategy, fold_sort=fold)
@@ -101,18 +107,24 @@ def audit_tapped_step(vocab: int = 30_000_000, width: int = 8,
     return {
         "optimizer": optimizer, "strategy": strategy,
         "lookup_path": lookup_path or "default", "fold": fold,
+        "hot_rows": hot_rows,
         "n_exchange_groups": n_groups, "sort_bound": bound,
         **{f"hlo_{k}": v for k, v in counts.items()},
     }
 
 
 DEFAULT_ARMS = (
-    # (optimizer, strategy, lookup_path)
-    ("adagrad", "sort", None),
-    ("adagrad", "tiled", None),
-    ("adam", "sort", None),
-    ("sgd", "tiled", None),
-    ("adagrad", "tiled", "tiled"),
+    # (optimizer, strategy, lookup_path, hot_rows)
+    ("adagrad", "sort", None, 0),
+    ("adagrad", "tiled", None, 0),
+    ("adam", "sort", None, 0),
+    ("sgd", "tiled", None, 0),
+    ("adagrad", "tiled", "tiled", 0),
+    # hot-row replication (ISSUE 4): same sort bound as the hot-less arm —
+    # the membership split (searchsorted) and the replicated dense hot
+    # update must add ZERO sort instructions per exchange group
+    ("adagrad", "sort", None, 1024),
+    ("sgd", "sort", None, 1024),
 )
 
 
@@ -130,12 +142,13 @@ def main(argv=None) -> int:
     jax.config.update("jax_platforms",
                       os.environ.get("JAX_PLATFORMS") or "cpu")
     failures = []
-    for optimizer, strategy, lookup in DEFAULT_ARMS:
+    for optimizer, strategy, lookup, hot_rows in DEFAULT_ARMS:
         folds = (True, False) if args.unfolded else (True,)
         for fold in folds:
             rec = audit_tapped_step(vocab=args.vocab, width=args.width,
                                     optimizer=optimizer, strategy=strategy,
-                                    lookup_path=lookup, fold=fold)
+                                    lookup_path=lookup, fold=fold,
+                                    hot_rows=hot_rows)
             if fold and rec["hlo_sort"] > rec["sort_bound"]:
                 rec["over_bound"] = True
                 failures.append(rec)
