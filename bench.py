@@ -631,7 +631,17 @@ def run_hotrows_bench(vocab: int = 2_000_000, width: int = 128,
         "hot_hit_ids": rep["hot_hit_ids"],
         "true_ids_post_hot": rep["true_ids_post_hot"],
         "hot_hit_rates": {str(k): round(v, 4)
-                          for k, v in rep["hot_hit_rates"].items()}}
+                          for k, v in rep["hot_hit_rates"].items()},
+        # exchange byte accounting (ISSUE 5 backfill): wire formats +
+        # id/activation bytes per sample, so hot-row records carry the
+        # same statically auditable wire fields as --mode wire
+        "exchanged_bytes": rep["exchanged_bytes"],
+        "true_bytes": rep["true_bytes"],
+        "act_bytes": rep["act_bytes"],
+        "act_bytes_f32": rep["act_bytes_f32"],
+        "act_wire_reduction": round(rep["act_wire_reduction"], 3),
+        "wire_dtypes": {str(k): v for k, v in rep["wire_dtypes"].items()},
+        "id_narrowed_groups": rep["id_narrowed_groups"]}
     # gate: the hot split adds ZERO sort instructions per exchange group
     # (searchsorted membership + dense replicated update; see
     # tools/hlo_audit.py) — lowering-only, tunnel-safe
@@ -681,6 +691,150 @@ def hotrows_main(argv=None) -> int:
                   "hotrows_error": str(e)[:300], "git_sha": _git_sha()}
     print(json.dumps(record))
     return 0 if "hotrows_error" not in record else 1
+
+
+# ------------------------------------------------------------------ wire
+def run_wire_bench(vocab: int = 100_000, width: int = 128, tables: int = 8,
+                   batch: int = 8192, hotness: int = 1, world: int = 8,
+                   iters: int = 5, optimizer: str = "adagrad",
+                   wire: str = "bf16", seed: int = 0) -> dict:
+    """Wire-compression A/B (ISSUE 5): the tapped sparse train step over a
+    `world`-device mesh at the DLRM-ish shape, f32 vs compressed exchange
+    wire (`DistributedEmbedding(exchange_wire=...)`).
+
+    Arms share weights, data and the timing method of record (scanned
+    multi-step program, slope-timed, loss-fetch-synced — see
+    `_slope_time_scan`). The record carries: both step times, the
+    warm-up-loss parity marker between arms (bf16 rounds ONE cast per
+    wire crossing, so losses agree to bf16 tolerance, never bit-exactly),
+    the static byte accounting from `exchange_padding_report`, and the
+    compiled-HLO collective-byte audit of both lowered steps (the
+    `tools/hlo_audit.py` wire arm) — so the halved-wire claim is
+    auditable from this one JSON line. Runs on any backend with >= 2
+    devices in the mesh (CPU uses virtual devices; single-chip TPU has
+    no exchange to compress and reports a skip marker)."""
+    from distributed_embeddings_tpu.parallel.mesh import create_mesh
+
+    devs = jax.devices()
+    world = min(world, len(devs))
+    record = {
+        "metric": "wire_exchange_train_ab",
+        "backend": devs[0].platform,
+        "wire_vocab": vocab, "wire_width": width, "wire_tables": tables,
+        "wire_batch": batch, "wire_hotness": hotness, "wire_world": world,
+        "wire_optimizer": optimizer, "wire_iters": iters,
+        "wire_format": wire,
+        "git_sha": _git_sha(),
+    }
+    if world < 2:
+        record["wire_error"] = (
+            f"wire A/B needs a multi-device mesh, have {len(devs)} "
+            "device(s) — no exchange collective exists at world 1")
+        return record
+    mesh = create_mesh(devs[:world])
+    rng = np.random.RandomState(seed)
+    # ONE copy of the tapped-model harness (tools/hlo_audit._build_model):
+    # the A/B times exactly the program the byte audit lowers
+    _ha = _load_hlo_audit()
+
+    nb = 2
+    data = [
+        (np.zeros((batch, 1), np.float32),
+         tuple(rng.randint(0, vocab, size=(batch, hotness)).astype(np.int32)
+               for _ in range(tables)),
+         rng.randn(batch).astype(np.float32))
+        for _ in range(nb)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
+                           *[(jnp.asarray(n), tuple(map(jnp.asarray, c)),
+                              jnp.asarray(l)) for (n, c, l) in data])
+
+    def time_arm(wire_fmt, key):
+        model = _ha._build_model(vocab, width, "sum", tables=tables,
+                                 mesh=mesh, exchange_wire=wire_fmt)
+        emb = model.embedding
+        params = {"embedding": emb.init(jax.random.PRNGKey(seed))}
+        init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.01)
+        opt_state = init_fn(params)
+        dt, warm, raw = _slope_time_scan(step_fn, params, opt_state,
+                                         stacked, nb, iters)
+        record[f"{key}_ms"] = round(dt * 1e3, 3)
+        record[f"{key}_raw"] = raw
+        return dt, warm, emb
+
+    dt_f32, losses_f32, _ = time_arm("f32", "wire_f32")
+    dt_c, losses_c, emb_c = time_arm(wire, "wire_compressed")
+    reliable = dt_f32 > 1e-6 and dt_c > 1e-6
+    record["wire_speedup"] = (round(dt_f32 / dt_c, 3) if reliable else 0.0)
+    # parity marker: identical data + init, so the warm-up losses differ
+    # only by the wire rounding — bounded, never zero for bf16
+    n = min(len(losses_f32), len(losses_c))
+    dev = float(np.max(np.abs(losses_f32[:n] - losses_c[:n])))
+    scale = float(np.max(np.abs(losses_f32[:n]))) or 1.0
+    record["wire_loss_max_dev"] = dev
+    record["wire_loss_rel_dev"] = round(dev / scale, 6)
+    rep = emb_c.exchange_padding_report(hotness=[hotness] * tables)
+    record["wire_padding_report"] = {
+        "act_bytes": rep["act_bytes"],
+        "act_bytes_f32": rep["act_bytes_f32"],
+        "act_wire_reduction": round(rep["act_wire_reduction"], 3),
+        "exchanged_bytes": rep["exchanged_bytes"],
+        "true_bytes": rep["true_bytes"],
+        "wire_dtypes": {str(k): v for k, v in rep["wire_dtypes"].items()},
+        "id_narrowed_groups": rep["id_narrowed_groups"],
+    }
+    # compiled-HLO byte audit of the same step shape (lowering-only, so
+    # it is tunnel-safe and CI-checkable)
+    try:
+        arms = _ha.wire_byte_arms(
+            vocab=min(vocab, 4096), width=width, tables=tables,
+            batch=min(batch, 64), hotness=hotness,
+            optimizer=optimizer, world=world)
+        record["wire_hlo"] = arms
+        comp = arms[1]
+        record["wire_hlo_reduction"] = comp.get(
+            "float_bytes_reduction_vs_f32")
+    except Exception as e:  # noqa: BLE001 - audit must not kill the bench
+        record["wire_hlo_error"] = str(e)[:200]
+    return record
+
+
+def wire_main(argv=None) -> int:
+    """`bench.py --mode wire` entry point: one JSON line, like main()."""
+    import argparse
+    p = argparse.ArgumentParser(description="exchange wire-compression "
+                                            "benchmark")
+    p.add_argument("--mode", choices=["wire"], default="wire")
+    p.add_argument("--vocab", type=int, default=100_000)
+    p.add_argument("--width", type=int, default=128)
+    p.add_argument("--tables", type=int, default=8)
+    p.add_argument("--batch", type=int, default=8192)
+    p.add_argument("--hotness", type=int, default=1)
+    p.add_argument("--world", type=int, default=8)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--optimizer", default="adagrad",
+                   choices=["sgd", "adagrad", "adam"])
+    p.add_argument("--wire", default="bf16", choices=["bf16", "bf16-sr"])
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    # the A/B needs a real mesh: request virtual CPU devices while the
+    # backend is still uninitialized (hlo_audit._ensure_world — ONE copy
+    # of the XLA_FLAGS dance; a real pod ignores it and uses its world)
+    _load_hlo_audit()._ensure_world(max(2, args.world))
+    try:
+        record = run_wire_bench(
+            vocab=args.vocab, width=args.width, tables=args.tables,
+            batch=args.batch, hotness=args.hotness, world=args.world,
+            iters=args.iters, optimizer=args.optimizer, wire=args.wire,
+            seed=args.seed)
+    except Exception as e:  # noqa: BLE001 - one JSON line, like main()
+        import traceback
+        traceback.print_exc()
+        record = {"metric": "wire_exchange_train_ab",
+                  "wire_error": str(e)[:300], "git_sha": _git_sha()}
+    print(json.dumps(record))
+    return 0 if "wire_error" not in record else 1
 
 
 # ---------------------------------------------------------------- ingest
@@ -845,6 +999,10 @@ def run_ingest_bench(batches: int = 32, batch: int = 16384,
                                             depth=depth)))
             results = {}
             raw = []
+            # per-arm per-stage histograms MERGED across reps
+            # (LatencyHistogram.merge): the aggregate distribution, not
+            # just whichever rep happened to run last
+            agg_hists: dict = {}
             for rep in range(max(1, reps)):
                 for label, make_pipe in arms:
                     pipe = make_pipe()
@@ -856,6 +1014,14 @@ def run_ingest_bench(batches: int = 32, batch: int = 16384,
                     stage_ms = {name: s["mean_ms"] for name, s
                                 in pipe.stage_summaries().items()}
                     stage_ms["consume"] = consume_hist.summary()["mean_ms"]
+                    rep_hists = dict(pipe.stage_histograms())
+                    rep_hists["consume"] = consume_hist
+                    tgt = agg_hists.setdefault(label, {})
+                    for name, h in rep_hists.items():
+                        if name in tgt:
+                            tgt[name].merge(h)
+                        else:
+                            tgt[name] = h
                     res = {"samples_per_sec": round(n * batch / dt),
                            "wall_s": round(dt, 3), "stage_ms": stage_ms}
                     raw.append({"rep": rep, "arm": label, **res})
@@ -891,6 +1057,12 @@ def run_ingest_bench(batches: int = 32, batch: int = 16384,
                 else 0.0,
                 "ingest_reps": max(1, reps),
                 "ingest_raw": raw,
+                # all-reps aggregate per-stage distributions (merged
+                # histograms; the headline stage_ms fields above remain
+                # the best-rep contention-free estimate)
+                "ingest_stage_summary_all_reps": {
+                    arm: {name: h.summary() for name, h in hs.items()}
+                    for arm, hs in agg_hists.items()},
                 "ingest_vocab_built": int(sum(lk.size for lk in lookups)),
                 "git_sha": _git_sha(),
             }
@@ -1408,6 +1580,8 @@ if __name__ == "__main__":
         sys.exit(ingest_main(sys.argv[1:]))
     elif _cli_mode() == "hotrows":
         sys.exit(hotrows_main(sys.argv[1:]))
+    elif _cli_mode() == "wire":
+        sys.exit(wire_main(sys.argv[1:]))
     elif os.environ.get("DET_BENCH_INNER") == "1":
         main()
     else:

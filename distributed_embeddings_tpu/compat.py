@@ -20,7 +20,8 @@ from typing import Optional
 
 import jax
 
-__all__ = ["shard_map", "host_memory_kind", "default_memory_kind"]
+__all__ = ["shard_map", "host_memory_kind", "default_memory_kind",
+           "install_cpu_donation_cache_guard"]
 
 
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
@@ -62,3 +63,65 @@ def default_memory_kind(device) -> Optional[str]:
         if "device" in kinds:
             return "device"
         return next(iter(kinds), None)
+
+
+_donation_cache_guard_installed = False
+
+
+def install_cpu_donation_cache_guard() -> bool:
+    """Bypass the persistent compilation cache for DONATED modules on the
+    XLA:CPU backend (idempotent; returns True when the guard is active).
+
+    jaxlib 0.4.36's CPU runtime intermittently mis-executes executables
+    **deserialized from the persistent compilation cache** when the
+    module carries input->output buffer donation (`tf.aliasing_output`
+    on unsharded modules, `jax.buffer_donor` on sharded ones — the
+    donated sharded train step lowers with the latter):
+    roughly 1 in 5 cache-loaded donated train steps computes structurally
+    wrong numerics (~7% off on a small training loss), consistently for
+    the lifetime of that loaded executable, while the freshly-compiled
+    twin of the SAME StableHLO is always correct. Isolated empirically
+    (tests/conftest.py enables the cache; the wire-compression bit-exact
+    A/B tests build identical donated steps twice per process, which
+    made the load path hot): 225/225 builds correct with the cache off,
+    135/135 correct with the cache on and donation off, ~20% of
+    processes wrong with both on. Undonated programs (forwards, inits,
+    set_weights) load correctly, so the guard scopes the bypass to
+    donated modules on CPU: they always compile fresh — correctness over
+    compile-time reuse — and everything else keeps the cache. TPU/GPU
+    backends are untouched.
+    """
+    global _donation_cache_guard_installed
+    if _donation_cache_guard_installed:
+        return True
+    try:
+        from jax._src import compilation_cache as _comp_cache
+        from jax._src import compiler as _compiler
+        orig = _compiler.compile_or_get_cached
+        backend_compile = _compiler.backend_compile
+        cache_in_use = _comp_cache.is_cache_used
+    except Exception:  # noqa: BLE001 - internal layout changed; newer
+        return False   # jax releases carry the runtime fix anyway
+
+    def _compile_or_get_cached(backend, computation, devices,
+                               compile_options, host_callbacks,
+                               *args, **kwargs):
+        # the O(module-text) donation probe only runs where the hazard
+        # exists: CPU backend AND the persistent cache actually enabled
+        if (getattr(backend, "platform", None) == "cpu"
+                and cache_in_use(backend)):
+            try:
+                text = str(computation)
+                donated = ("tf.aliasing_output" in text
+                           or "jax.buffer_donor" in text)
+            except Exception:  # noqa: BLE001 - unprintable module
+                donated = True  # fail safe: skip the cache
+            if donated:
+                return backend_compile(backend, computation,
+                                       compile_options, host_callbacks)
+        return orig(backend, computation, devices, compile_options,
+                    host_callbacks, *args, **kwargs)
+
+    _compiler.compile_or_get_cached = _compile_or_get_cached
+    _donation_cache_guard_installed = True
+    return True

@@ -48,6 +48,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_embeddings_tpu import compat
 from distributed_embeddings_tpu.ops import embedding_ops, pallas_lookup
 from distributed_embeddings_tpu.ops import sparse_update as sparse_update_ops
+from distributed_embeddings_tpu.ops import wire as wire_ops
 from distributed_embeddings_tpu.ops.embedding_ops import (GroupSort,
                                                           RaggedIds,
                                                           SparseIds,
@@ -354,6 +355,14 @@ class DistributedEmbedding:
         world_size is taken from the mesh.
       input_max_hotness: optional per-input static max hotness, required to
         accept RaggedIds inputs (TPU needs static shapes).
+      exchange_wire: float wire format for the exchange collectives
+        (ISSUE 5): 'f32' (default — the exact pre-seam collectives),
+        'bf16' (half the activation/weight/gradient exchange bytes, f32
+        math on both sides), or 'bf16-sr' (bf16 forward, stochastically
+        rounded bf16 gradients). None defers to `DET_EXCHANGE_WIRE`.
+        Gated off per bucket where the planner knows rounding would be
+        user-visible (combiner-None passthrough buckets keep f32); see
+        `exchange_padding_report` for the resulting byte accounting.
     """
 
     def __init__(self,
@@ -370,7 +379,8 @@ class DistributedEmbedding:
                  input_max_hotness: Optional[Sequence[Optional[int]]] = None,
                  use_custom_kernel: bool = True,
                  compute_dtype: Optional[Any] = None,
-                 hot_rows: Optional[int] = None):
+                 hot_rows: Optional[int] = None,
+                 exchange_wire: Optional[str] = None):
         if mesh is None and world_size is not None and world_size > 1:
             mesh = create_mesh(jax.devices()[:world_size])
         self.mesh = mesh
@@ -401,7 +411,8 @@ class DistributedEmbedding:
             data_parallel_threshold=dp_thr,
             gpu_embedding_size=gpu_embedding_size,
             input_hotness=input_max_hotness,
-            hot_rows=(hot_rows if dp_input else 0))
+            hot_rows=(hot_rows if dp_input else 0),
+            exchange_wire=exchange_wire)
 
         if self.strategy.table_groups[1]:
             if not all(self.strategy.local_configs):
@@ -818,11 +829,25 @@ class DistributedEmbedding:
         rate. Pass `hot_hit_rate` (scalar or {bucket: rate}) to project
         for an assumed rate instead.
 
+        Wire compression (ISSUE 5): every group entry also carries the
+        BYTE-level accounting of its wire — `wire_dtype` /
+        `id_wire_dtype` (the plan's per-bucket formats),
+        `exchanged_bytes` / `true_bytes` (id wire + the mp->dp
+        activation return, forward direction, per global sample) and
+        `act_bytes` vs `act_bytes_f32` (the dominant activation term at
+        the actual vs the f32 wire). Top-level `act_wire_reduction` is
+        the statically auditable compression claim: 2.0 when every
+        bucket rides bf16, 1.0 at the f32 default. The gradient
+        transpose moves the same activation volume again (same ratio);
+        weighted inputs add `weight_bytes_if_weighted` per group.
+
         Args:
           hotness: per-tp-input hotness override; defaults to the layer's
             input_max_hotness hints (unhinted inputs count as 1).
           hot_hit_rate: hot-shard hit-rate override (see above).
         Returns {"groups": [...], "true_ids", "exchanged_ids", "ratio",
+        "exchanged_bytes", "true_bytes", "act_bytes", "act_bytes_f32",
+        "act_wire_reduction", "wire_dtypes", "id_narrowed_groups",
         "hot_hit_ids", "true_ids_post_hot", "hot_hit_rates"}.
         """
         tp_inputs = self.strategy.input_groups[1]
@@ -847,15 +872,49 @@ class DistributedEmbedding:
         key = tuple((int(h), False) for h in hotness)
         groups, _ = self._exchange_groups_for_key(key)
         report, true_tot, ex_tot, hot_tot = [], 0, 0, 0
-        for g in groups:
+        ex_bytes_tot, true_bytes_tot = 0, 0
+        act_bytes_tot, act_bytes_f32_tot = 0, 0
+        id_narrowed = []
+        for gi, g in enumerate(groups):
+            bucket = self.plan.tp_buckets[g.bucket]
             true_ids = sum(len(s) for s in g.rank_slots) * g.k
             ex_ids = self.world_size * g.f_max * g.k
             true_tot += true_ids
             ex_tot += ex_ids
+            # byte-level accounting (ISSUE 5), per global sample: the id
+            # wire at the bucket's (possibly int16-narrowed) id dtype
+            # plus the mp->dp combined-activation return — one slot is
+            # width elements combined (width*k for passthrough) — at the
+            # bucket's float wire. FORWARD volume; the gradient
+            # transpose doubles the activation term, and weighted inputs
+            # add one more id-shaped float block at the same wire
+            # (`weight_bytes_if_weighted`).
+            w_out = bucket.width * (1 if bucket.combiner is not None
+                                    else g.k)
+            id_b = wire_ops.id_wire_itemsize(bucket.id_wire_dtype)
+            wire_b = wire_ops.wire_itemsize(bucket.wire_dtype)
+            act_ex = self.world_size * g.f_max * w_out
+            act_true = sum(len(s) for s in g.rank_slots) * w_out
+            ex_bytes = ex_ids * id_b + act_ex * wire_b
+            true_bytes = true_ids * id_b + act_true * wire_b
+            ex_bytes_tot += ex_bytes
+            true_bytes_tot += true_bytes
+            act_bytes_tot += act_ex * wire_b
+            act_bytes_f32_tot += act_ex * 4
+            if bucket.id_wire_dtype == "int16":
+                id_narrowed.append(gi)
             entry = {
                 "bucket": g.bucket, "hotness": g.k, "f_max": g.f_max,
                 "features_per_rank": [len(s) for s in g.rank_slots],
                 "true_ids": true_ids, "exchanged_ids": ex_ids,
+                "wire_dtype": bucket.wire_dtype,
+                "id_wire_dtype": bucket.id_wire_dtype,
+                "act_width": w_out,
+                "act_bytes": act_ex * wire_b,
+                "act_bytes_f32": act_ex * 4,
+                "exchanged_bytes": ex_bytes,
+                "true_bytes": true_bytes,
+                "weight_bytes_if_weighted": ex_ids * wire_b,
                 "path_taken": self._exchange_path_taken.get(
                     (g.bucket, g.f_max, g.k)),
             }
@@ -869,6 +928,18 @@ class DistributedEmbedding:
         return {"groups": report, "true_ids": true_tot,
                 "exchanged_ids": ex_tot,
                 "ratio": (ex_tot / true_tot) if true_tot else 1.0,
+                "exchanged_bytes": ex_bytes_tot,
+                "true_bytes": true_bytes_tot,
+                "act_bytes": act_bytes_tot,
+                "act_bytes_f32": act_bytes_f32_tot,
+                # f32-wire bytes / actual-wire bytes of the dominant
+                # (activation) exchange: 1.0 all-f32, 2.0 all-bf16 — the
+                # statically auditable half-the-wire claim
+                "act_wire_reduction": (act_bytes_f32_tot / act_bytes_tot
+                                       if act_bytes_tot else 1.0),
+                "wire_dtypes": {b: bk.wire_dtype for b, bk in
+                                enumerate(self.plan.tp_buckets)},
+                "id_narrowed_groups": id_narrowed,
                 "hot_hit_ids": hot_tot,
                 "true_ids_post_hot": true_tot - hot_tot,
                 "hot_hit_rates": {b: rate_for(b) for b in self._hot_buckets},
@@ -1205,7 +1276,7 @@ class DistributedEmbedding:
                 tap_g = None if taps is None else taps["tp"][g]
                 if tap_g is not None:
                     out = out + tap_g[0].astype(out.dtype)
-                ex = self._tp_bucket_exchange(out)
+                ex = self._tp_bucket_exchange(out, bucket.wire_dtype)
                 hot_tap = None if hot_taps is None else hot_taps[g]
                 contrib = self._hot_contrib(grp, bucket, hot, hot_info[0],
                                             hot_info[1], hot_tap)
@@ -1217,7 +1288,8 @@ class DistributedEmbedding:
                     tp_params, grp, ids_x, w_x,
                     None if taps is None else taps["tp"][g],
                     presorted=sort_g)
-                ex_list.append(self._tp_bucket_exchange(out))
+                ex_list.append(self._tp_bucket_exchange(
+                    out, bucket.wire_dtype))
             if want_res:
                 if hot_info is not None:
                     # w_x IS the effective weight stream (see above)
@@ -1280,7 +1352,14 @@ class DistributedEmbedding:
     def _padded_id_exchange(self, grp, ids, w, world, blocal):
         """Fixed-shape dp->mp id (+weight) exchange: dense
         [world, B_l, f_max, k] blocks through lax.all_to_all (padding
-        bounded by the comm_balanced placement)."""
+        bounded by the comm_balanced placement).
+
+        Wire formats (ISSUE 5, from the bucket's plan fields): the id
+        block narrows to int16 where the planner proved the key space
+        fits (losslessly — see ops/wire.py encode_ids), and the weight
+        block rides the bucket's float wire. Both decode back to full
+        width before any local math."""
+        bucket = self.plan.tp_buckets[grp.bucket]
         sel = jnp.asarray(grp.sel.reshape(-1))           # [world*f_max]
         send = jnp.take(ids, sel, axis=1).reshape(
             blocal, world, grp.f_max, grp.k)
@@ -1291,11 +1370,14 @@ class DistributedEmbedding:
                 blocal, world, grp.f_max, grp.k)
             w_send = jnp.moveaxis(w_send, 1, 0)
         if world > 1:
-            recv = lax.all_to_all(send, self.axis, split_axis=0,
-                                  concat_axis=0)
+            recv = wire_ops.decode_ids(
+                lax.all_to_all(
+                    wire_ops.encode_ids(send, bucket.id_wire_dtype),
+                    self.axis, split_axis=0, concat_axis=0),
+                bucket.id_wire_dtype, send.dtype)
             if w is not None:
-                w_recv = lax.all_to_all(w_send, self.axis, split_axis=0,
-                                        concat_axis=0)
+                w_recv = wire_ops.wire_all_to_all(w_send, self.axis,
+                                                  bucket.wire_dtype)
                 w_x = w_recv.reshape(-1, grp.f_max, grp.k)
         else:
             recv = send
@@ -1309,7 +1391,21 @@ class DistributedEmbedding:
         shared core of `_ragged_id_exchange` and the hot split's
         `_exchange_send` (ONE copy of the split metadata, the
         DET_RAGGED_NATIVE choice and the receive-layout reassembly, so
-        the two callers cannot drift)."""
+        the two callers cannot drift).
+
+        The operand crosses at its bucket's wire format (ISSUE 5),
+        dispatched by dtype: int operands take the id wire (int16 where
+        the planner proved the range), float operands the float wire.
+        The float encode/decode pair is differentiable, so the reverse
+        ragged exchange of the weight gradient rides the same wire
+        (no custom_vjp needed — the cast transposes bound it)."""
+        bucket = self.plan.tp_buckets[grp.bucket]
+        orig_dtype = operand.dtype
+        is_int = jnp.issubdtype(orig_dtype, jnp.integer)
+        if is_int:
+            operand = wire_ops.encode_ids(operand, bucket.id_wire_dtype)
+        else:
+            operand = wire_ops.encode_fwd(operand, bucket.wire_dtype)
         me = self._my_index()
         f_pr = jnp.asarray(grp.f_per_rank)
         in_off = jnp.asarray(grp.in_offsets)
@@ -1322,6 +1418,11 @@ class DistributedEmbedding:
                             operand.dtype)
         recv = _ragged_exchange_op(operand, out_buf, in_off, f_pr,
                                    out_off, recv_sz, self.axis, native)
+        if is_int:
+            recv = wire_ops.decode_ids(recv, bucket.id_wire_dtype,
+                                       orig_dtype)
+        else:
+            recv = recv.astype(orig_dtype)
         recv = recv.reshape(world, grp.f_max, blocal, grp.k)
         return jnp.moveaxis(recv, 2, 1).reshape(-1, grp.f_max, grp.k)
 
@@ -1451,14 +1552,18 @@ class DistributedEmbedding:
         `_padded_id_exchange` / `_ragged_id_exchange` (the split must mask
         per (destination, slot) lane, which only exists post-`sel`).
         Returns (ids_x [B, f, k], w_x [B, f, k]) matching the stock
-        exchanges byte for byte."""
+        exchanges byte for byte (incl. their wire formats, ISSUE 5)."""
+        bucket = self.plan.tp_buckets[grp.bucket]
         if not self._use_ragged_exchange(grp, world):
             if world > 1:
-                recv = lax.all_to_all(send, self.axis, split_axis=0,
-                                      concat_axis=0)
+                recv = wire_ops.decode_ids(
+                    lax.all_to_all(
+                        wire_ops.encode_ids(send, bucket.id_wire_dtype),
+                        self.axis, split_axis=0, concat_axis=0),
+                    bucket.id_wire_dtype, send.dtype)
                 w_recv = (None if w_send is None else
-                          lax.all_to_all(w_send, self.axis, split_axis=0,
-                                         concat_axis=0))
+                          wire_ops.wire_all_to_all(w_send, self.axis,
+                                                   bucket.wire_dtype))
             else:
                 recv, w_recv = send, w_send
             return (recv.reshape(-1, grp.f_max, grp.k),
@@ -1620,14 +1725,21 @@ class DistributedEmbedding:
                 return out
         return self._host_group_exchange(table, grp, off_id, off_w, tap_g, g)
 
-    def _tp_bucket_exchange(self, out: jax.Array) -> jax.Array:
+    def _tp_bucket_exchange(self, out: jax.Array,
+                            wire: str = "f32") -> jax.Array:
         """mp->dp movement of one bucket's outputs: [B, f, wf] ->
-        [world_src, B_l, f, wf] (reference hvd.alltoall :870-872)."""
+        [world_src, B_l, f, wf] (reference hvd.alltoall :870-872).
+
+        `wire` (the bucket's plan `wire_dtype`, ISSUE 5) compresses the
+        activation block on the wire — and, through the custom-vjp
+        transpose, the dp->mp GRADIENT block of the backward pass —
+        while the math on both sides stays at the caller's dtype. 'f32'
+        lowers to the exact pre-seam `lax.all_to_all`."""
         world = self.world_size
         if world > 1:
             blocal = out.shape[0] // world
             x = out.reshape((world, blocal) + out.shape[1:])
-            return lax.all_to_all(x, self.axis, split_axis=0, concat_axis=0)
+            return wire_ops.wire_all_to_all(x, self.axis, wire)
         return out[None]
 
     def _row_slice_local(self, row_params, row_in, row_taps=None,
@@ -1642,9 +1754,17 @@ class DistributedEmbedding:
             t = strat.map_groups[2][j]
             rt = self.plan.row_tables[t]
             if world > 1:
-                ids = lax.all_gather(ids, self.axis, axis=0, tiled=True)
+                # wire formats (ISSUE 5) from the row-table plan: int16
+                # id wire where the TOTAL row count provably fits, the
+                # float wire on the weight broadcast
+                ids = wire_ops.decode_ids(
+                    lax.all_gather(
+                        wire_ops.encode_ids(ids, rt.id_wire_dtype),
+                        self.axis, axis=0, tiled=True),
+                    rt.id_wire_dtype, ids.dtype)
                 if weights is not None:
-                    weights = lax.all_gather(weights, self.axis, axis=0, tiled=True)
+                    weights = wire_ops.wire_all_gather(
+                        weights, self.axis, rt.wire_dtype, world)
             base = self._device_const(rt.row_base)
             nrows = self._device_const(np.asarray(rt.rows_per_rank, np.int32))
             local = ids - base.astype(ids.dtype)
@@ -1668,8 +1788,12 @@ class DistributedEmbedding:
             if row_taps is not None:
                 out = out + row_taps[j][0].astype(out.dtype)
             if world > 1:
-                out = lax.psum_scatter(out, self.axis, scatter_dimension=0,
-                                       tiled=True)
+                # the partial-sum return rides the float wire; under a
+                # compressed wire the reduce-scatter re-expresses as
+                # all_to_all + LOCAL f32 accumulation, so cross-device
+                # adds never run at wire precision (ops/wire.py)
+                out = wire_ops.wire_psum_scatter(out, self.axis,
+                                                 rt.wire_dtype, world)
             row_outs.append(out)
             if want_res:
                 # OOB sentinel rows_max: dropped by the sparse scatter
@@ -2145,7 +2269,8 @@ class DistributedEmbedding:
                         tp_params, grp, ids_l, w_l,
                         None if taps_l is None else taps_l["tp"][g],
                         presorted=sort_g)
-                    ex_list.append(self._tp_bucket_exchange(out))
+                    ex_list.append(self._tp_bucket_exchange(
+                        out, bucket.wire_dtype))
                 if return_residuals:
                     eff_w, _ = _effective_weights(w_l, grp.k, bucket.combiner)
                     res_ids.append(ids_l[None].astype(jnp.int32))

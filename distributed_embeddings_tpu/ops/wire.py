@@ -1,0 +1,309 @@
+"""Wire formats for the dp<->mp exchange collectives (ISSUE 5).
+
+Every float collective in the embedding forward/backward — the mp->dp
+combined-activation `all_to_all` (layers/dist_model_parallel.py
+`_tp_bucket_exchange`), its autodiff transpose moving gradients dp->mp,
+the dp->mp weight exchange (padded and ragged), and the row-sliced path's
+`psum_scatter`/`all_gather` pair — moves f32 in the reference stack. On
+TPU the standard mixed-precision lever is a **bf16 wire format with f32
+local math**: encode to bf16 immediately before the collective, decode
+immediately after, so the only numerics change is ONE round-to-nearest
+per wire crossing while every gather/combine/update stays f32. That
+exactly halves the dominant exchange bytes (the `[world, B, f, w]`
+activation blocks) without touching the int id wire.
+
+Formats:
+  * ``f32``      — identity. The default; callers early-return to the
+                   plain `lax` collective, so the lowered program is
+                   byte-identical to the pre-wire-seam code.
+  * ``bf16``     — round-to-nearest-even bf16 on the wire, both
+                   directions.
+  * ``bf16-sr``  — bf16 forward; **stochastically rounded** bf16 for the
+                   gradient direction. SR spreads the rounding over both
+                   neighbors with distance-proportional probability, so
+                   ACROSS the many distinct gradient values of a step the
+                   wire error centers on zero instead of carrying RNE's
+                   systematic bias (the classic low-precision-training
+                   argument). The randomness is a counter-less hash of
+                   (lane position, value bits) — deterministic per trace,
+                   no PRNG key plumbing through the collective seam; the
+                   flip side is that the SAME value at the SAME lane
+                   rounds the same way every step, so per-coordinate
+                   zero-mean over time is NOT guaranteed (pass a
+                   different ``salt`` per step if that matters).
+
+The gradient direction is wrapped in `jax.custom_vjp` so the transpose
+collective compresses with the *gradient* wire format and local math
+stays f32 on both sides — in particular `wire_psum_scatter` re-expresses
+the reduce-scatter as encode -> all_to_all -> decode -> f32 local sum, so
+cross-device ACCUMULATION never happens in bf16 (a plain bf16
+`psum_scatter` would round once per ring hop).
+
+Int id wire: `encode_ids`/`decode_ids` narrow int32 ids to int16 where
+the planner proves every value that can legally cross the wire fits
+(`parallel/plan.py` sets ``TPBucket.id_wire_dtype`` — the same
+prove-the-key-space-fits gate style as PR 4's int32-key-overflow check).
+Encoding CLIPS to the int16 range: the planner gate guarantees every
+valid id and the hot sentinel sit strictly below the clip ceiling, so an
+out-of-range user id stays out-of-range after the round-trip and the
+downstream clamp/drop semantics are bit-identical to the int32 wire.
+"""
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "WIRE_FORMATS",
+    "ID_WIRE_FORMATS",
+    "default_exchange_wire",
+    "default_id_wire",
+    "resolve_wire",
+    "wire_itemsize",
+    "id_wire_itemsize",
+    "encode_fwd",
+    "encode_bwd",
+    "stochastic_round_bf16",
+    "encode_ids",
+    "decode_ids",
+    "int16_id_wire_ok",
+    "wire_all_to_all",
+    "wire_all_gather",
+    "wire_psum_scatter",
+]
+
+WIRE_FORMATS = ("f32", "bf16", "bf16-sr")
+ID_WIRE_FORMATS = ("int32", "int16")
+
+# clip ceiling of the int16 id wire; the planner admits a bucket only when
+# every legal wire value (valid ids AND the hot sentinel rows_max) is
+# strictly below it, so clipped out-of-range ids can never alias either
+INT16_ID_MAX = 2**15 - 1
+
+
+def default_exchange_wire() -> str:
+    """The ``DET_EXCHANGE_WIRE`` environment default for the float
+    exchange wire ('f32' unless overridden); an explicit
+    ``exchange_wire=`` constructor argument always wins."""
+    return resolve_wire(os.environ.get("DET_EXCHANGE_WIRE"))
+
+
+def default_id_wire() -> str:
+    """``DET_ID_WIRE``: 'auto' (default) lets the planner narrow the id
+    wire to int16 per bucket where the key space provably fits; 'int32'
+    forces the full-width id wire everywhere."""
+    v = os.environ.get("DET_ID_WIRE", "auto")
+    if v not in ("auto", "int32"):
+        raise ValueError(
+            f"DET_ID_WIRE={v!r}: expected 'auto' or 'int32'")
+    return v
+
+
+def resolve_wire(name: Optional[str]) -> str:
+    """Validate/normalize a wire-format name (None -> 'f32')."""
+    if name is None or name == "":
+        return "f32"
+    if name not in WIRE_FORMATS:
+        raise ValueError(
+            f"unknown exchange wire format {name!r}; expected one of "
+            f"{WIRE_FORMATS}")
+    return name
+
+
+def wire_itemsize(name: str) -> int:
+    """Bytes per element the float wire moves (accounting)."""
+    return 4 if resolve_wire(name) == "f32" else 2
+
+
+def id_wire_itemsize(name: str) -> int:
+    return 2 if name == "int16" else 4
+
+
+# ------------------------------------------------------------- encoders
+def encode_fwd(x: jax.Array, wire: str) -> jax.Array:
+    """Forward-direction wire encode (deterministic RNE for bf16*)."""
+    if wire == "f32":
+        return x
+    return x.astype(jnp.bfloat16)
+
+
+def encode_bwd(g: jax.Array, wire: str) -> jax.Array:
+    """Gradient-direction wire encode ('bf16-sr' -> stochastic round)."""
+    if wire == "f32":
+        return g
+    if wire == "bf16-sr":
+        return stochastic_round_bf16(g)
+    return g.astype(jnp.bfloat16)
+
+
+def stochastic_round_bf16(x: jax.Array, salt: int = 0x9E3779B9) -> jax.Array:
+    """f32 -> bf16 with stochastic rounding: P(round up) equals the
+    fractional distance to the upper representable neighbor, so over an
+    ensemble of distinct values the rounding error centers on zero
+    (E[sr(X)] == E[X] when the hash is exercised across many values).
+
+    The random source is a hash of (flat lane index, value bits, salt) —
+    no PRNG key crosses the collective seam, and the result is
+    deterministic for a given (array, salt), which keeps traced programs
+    reproducible. The trade: a value that REPEATS at the same lane
+    rounds identically every time, so the zero-mean property is across
+    values/lanes, not per coordinate over steps — mix a per-step
+    ``salt`` in if per-coordinate unbiasedness over time is required.
+    Non-finite and non-f32 inputs fall back to the deterministic cast
+    (adding noise bits to an inf/NaN pattern would corrupt it)."""
+    if x.dtype != jnp.float32:
+        return x.astype(jnp.bfloat16)
+    bits = lax.bitcast_convert_type(x, jnp.uint32)
+    # cheap integer mix (xxhash-style avalanche) of position ^ value bits
+    idx = lax.iota(jnp.uint32, x.size).reshape(x.shape)
+    h = bits ^ (idx * jnp.uint32(2654435761) + jnp.uint32(salt))
+    h = (h ^ (h >> 15)) * jnp.uint32(0x2C1B3C6D)
+    h = (h ^ (h >> 12)) * jnp.uint32(0x297A2D39)
+    h = h ^ (h >> 15)
+    rnd = h & jnp.uint32(0xFFFF)
+    up = ((bits + rnd) >> 16).astype(jnp.uint16)
+    sr = lax.bitcast_convert_type(up, jnp.bfloat16)
+    return jnp.where(jnp.isfinite(x), sr, x.astype(jnp.bfloat16))
+
+
+def int16_id_wire_ok(max_wire_value: int) -> bool:
+    """True when every legal wire value (valid pre-offset ids and the
+    sentinel) sits STRICTLY below the int16 clip ceiling — the
+    planner-side gate for narrowing one bucket's id wire."""
+    return 0 <= max_wire_value < INT16_ID_MAX
+
+
+def encode_ids(ids: jax.Array, id_wire: str) -> jax.Array:
+    """Narrow an int id block for the wire. Clipping (not wrapping) keeps
+    out-of-range ids out of range: the planner gate puts every legal
+    value strictly below INT16_ID_MAX, so a clipped invalid id can alias
+    neither a valid row nor the hot sentinel."""
+    if id_wire != "int16":
+        return ids
+    return jnp.clip(ids, -2**15, INT16_ID_MAX).astype(jnp.int16)
+
+
+def decode_ids(ids: jax.Array, id_wire: str,
+               dtype=jnp.int32) -> jax.Array:
+    if id_wire != "int16":
+        return ids
+    return ids.astype(dtype)
+
+
+# -------------------------------------------------- wrapped collectives
+@functools.lru_cache(maxsize=None)
+def _wired_all_to_all(axis: str, wire: str, dtype_name: str):
+    """custom_vjp all_to_all (split 0 / concat 0): wire-encoded operand
+    both directions, output decoded back to the caller's dtype. The
+    split0/concat0 all_to_all is its own transpose, so the bwd rule is
+    the same collective over the gradient wire."""
+    out_dtype = jnp.dtype(dtype_name)
+
+    def run(x, enc):
+        y = enc(x, wire)
+        y = lax.all_to_all(y, axis, split_axis=0, concat_axis=0)
+        return y.astype(out_dtype)
+
+    @jax.custom_vjp
+    def f(x):
+        return run(x, encode_fwd)
+
+    def fwd(x):
+        return run(x, encode_fwd), None
+
+    def bwd(_, g):
+        return (run(g, encode_bwd),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def wire_all_to_all(x: jax.Array, axis: str, wire: str) -> jax.Array:
+    """`lax.all_to_all(split 0 / concat 0)` behind the wire seam.
+
+    'f32' returns the plain collective — the lowered program is
+    byte-identical to pre-seam code (the bit-exactness contract of the
+    default path). Other formats compress the operand on the wire and
+    decode to the input dtype; the autodiff transpose compresses the
+    gradient with the format's gradient encoder."""
+    wire = resolve_wire(wire)
+    if wire == "f32":
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0)
+    return _wired_all_to_all(axis, wire, x.dtype.name)(x)
+
+
+@functools.lru_cache(maxsize=None)
+def _wired_all_gather(axis: str, wire: str, dtype_name: str, world: int):
+    """custom_vjp tiled all_gather over axis 0. The transpose of a tiled
+    all_gather is a tiled psum_scatter; it is expressed here as
+    encode -> all_to_all -> decode -> f32-local sum so cross-device
+    accumulation never happens at wire precision."""
+    out_dtype = jnp.dtype(dtype_name)
+
+    @jax.custom_vjp
+    def f(x):
+        y = lax.all_gather(encode_fwd(x, wire), axis, axis=0, tiled=True)
+        return y.astype(out_dtype)
+
+    def fwd(x):
+        return f(x), None
+
+    def bwd(_, g):                       # g: [B, ...] -> [B_l, ...]
+        h = encode_bwd(g, wire)
+        h = h.reshape((world, g.shape[0] // world) + g.shape[1:])
+        h = lax.all_to_all(h, axis, split_axis=0, concat_axis=0)
+        return (h.astype(out_dtype).sum(axis=0),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def wire_all_gather(x: jax.Array, axis: str, wire: str,
+                    world: int) -> jax.Array:
+    """Tiled `lax.all_gather` over axis 0 behind the wire seam (the
+    row-sliced path's weight broadcast)."""
+    wire = resolve_wire(wire)
+    if wire == "f32":
+        return lax.all_gather(x, axis, axis=0, tiled=True)
+    return _wired_all_gather(axis, wire, x.dtype.name, world)(x)
+
+
+@functools.lru_cache(maxsize=None)
+def _wired_psum_scatter(axis: str, wire: str, dtype_name: str, world: int):
+    """custom_vjp tiled psum_scatter over dim 0, wire-compressed:
+    fwd = encode -> all_to_all -> decode -> f32-local sum over sources
+    (same wire volume as the reduce-scatter ring, but every ADD runs at
+    the caller's precision); bwd = the transpose, a tiled all_gather of
+    the wire-encoded gradient."""
+    out_dtype = jnp.dtype(dtype_name)
+
+    @jax.custom_vjp
+    def f(x):                            # x: [B, ...] -> [B_l, ...]
+        y = encode_fwd(x, wire)
+        y = y.reshape((world, x.shape[0] // world) + x.shape[1:])
+        y = lax.all_to_all(y, axis, split_axis=0, concat_axis=0)
+        return y.astype(out_dtype).sum(axis=0)
+
+    def fwd(x):
+        return f(x), None
+
+    def bwd(_, g):
+        h = lax.all_gather(encode_bwd(g, wire), axis, axis=0, tiled=True)
+        return (h.astype(out_dtype),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def wire_psum_scatter(x: jax.Array, axis: str, wire: str,
+                      world: int) -> jax.Array:
+    """Tiled `lax.psum_scatter` over dim 0 behind the wire seam (the
+    row-sliced path's partial-sum return)."""
+    wire = resolve_wire(wire)
+    if wire == "f32":
+        return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    return _wired_psum_scatter(axis, wire, x.dtype.name, world)(x)

@@ -70,6 +70,16 @@ class TPBucket:
     # 0 = no hot shard. Set by lower_strategy from the planner's
     # hot_rows config, gated on eligibility (see _hot_capacity).
     hot_rows: int = 0
+    # wire formats of this bucket's exchange collectives (ISSUE 5):
+    # `wire_dtype` ('f32' | 'bf16' | 'bf16-sr') covers the float wire —
+    # the mp->dp activation all_to_all, its gradient transpose, and the
+    # dp->mp weight exchange; `id_wire_dtype` ('int32' | 'int16') covers
+    # the dp->mp id wire. Set by lower_strategy from the planner's
+    # exchange_wire request, gated per bucket (see _wire_eligibility /
+    # _id_wire_dtype); the defaults reproduce the pre-seam collectives
+    # bit for bit.
+    wire_dtype: str = "f32"
+    id_wire_dtype: str = "int32"
     # NOTE: runtime [world, f_max] sel/offset constants live on
     # _ExchangeGroup (dist_model_parallel._exchange_groups), grouped by
     # hotness — the bucket itself carries only placement structure.
@@ -86,6 +96,11 @@ class RowTablePlan:
     row_base: np.ndarray            # [world] global row base per rank
     initializer: Any
     dtype: Any
+    # exchange wire formats (ISSUE 5), mirroring TPBucket: `wire_dtype`
+    # covers the psum_scatter return / weight all_gather / their
+    # gradient transposes, `id_wire_dtype` the id all_gather.
+    wire_dtype: str = "f32"
+    id_wire_dtype: str = "int32"
 
 
 @dataclasses.dataclass
@@ -130,6 +145,44 @@ def _hot_capacity(bucket: TPBucket, hot_rows: int, world: int) -> int:
             "int32 membership keys", RuntimeWarning, stacklevel=3)
         return 0
     return min(hot_rows, max(sum(bucket.rows), 1))
+
+
+def _wire_eligibility(combiner: Optional[str], offload: bool,
+                      requested: str) -> str:
+    """Float wire format for one bucket/table, 'f32' when ineligible.
+
+    Gated off (kept f32) where the planner knows bf16 round-off would be
+    user-visible beyond the documented combine tolerance:
+
+      * combiner-None passthrough buckets return RAW embedding rows to
+        the user (no reduction to absorb the rounding) — a silently
+        rounded row is a user-visible numerics change, so passthrough
+        keeps the exact wire unless the user opts the whole layer into a
+        bf16 compute_dtype (which already rounds those rows).
+      * offloaded buckets: their mp->dp movement is a GSPMD host
+        resharding, not a lax collective — there is no wire here to
+        compress, and marking them f32 keeps the report honest.
+    """
+    if combiner is None or offload:
+        return "f32"
+    return requested
+
+
+def _id_wire_dtype(rows_max: int, id_wire_mode: str) -> str:
+    """Id wire for one bucket: 'int16' when the planner PROVES every
+    value that can cross the wire fits (the int32-key-overflow gate
+    style from PR 4, applied at the int16 boundary).
+
+    The dp->mp wire carries PRE-offset ids — valid ids are < the lane's
+    segment rows <= rows_max, and the hot split's sentinel is exactly
+    rows_max — so the proof obligation is rows_max strictly below the
+    int16 clip ceiling (the clip then keeps out-of-range user ids
+    out of range AND distinct from the sentinel; see ops/wire.py
+    encode_ids)."""
+    from distributed_embeddings_tpu.ops.wire import int16_id_wire_ok
+    if id_wire_mode == "auto" and int16_id_wire_ok(max(rows_max, 1)):
+        return "int16"
+    return "int32"
 
 
 def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
@@ -201,10 +254,16 @@ def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
                         TPSlot(tp_input=inp_pos, row_offset=row_offset))
                     tp_input_slots[inp_pos].append((rank, b, slot_idx))
 
+    from distributed_embeddings_tpu.ops.wire import default_id_wire
+    requested_wire = getattr(strategy, "exchange_wire", "f32")
+    id_wire_mode = default_id_wire()
     for bucket in buckets:
         bucket.f_max = max((len(s) for s in bucket.slots), default=0)
         bucket.hot_rows = _hot_capacity(
             bucket, getattr(strategy, "hot_rows", 0), world)
+        bucket.wire_dtype = _wire_eligibility(
+            bucket.combiner, bucket.offload, requested_wire)
+        bucket.id_wire_dtype = _id_wire_dtype(bucket.rows_max, id_wire_mode)
 
     # ---------------- row-sliced tables -------------------------------------
     row_tables: List[RowTablePlan] = []
@@ -217,11 +276,16 @@ def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
         base = np.asarray([-strategy.row_inputs_offsets[r][t]
                            for r in range(world)], dtype=np.int32)
         cfg0 = per_rank[0]
+        # the row wire carries GLOBAL ids (base subtraction is local), so
+        # the int16 proof obligation is the table's TOTAL row count
         row_tables.append(RowTablePlan(
             table_id=t, width=cfg0["output_dim"], combiner=cfg0.get("combiner"),
             rows_per_rank=rows, rows_max=max(rows), row_base=base,
             initializer=cfg0.get("embeddings_initializer", "uniform"),
-            dtype=cfg0.get("dtype")))
+            dtype=cfg0.get("dtype"),
+            wire_dtype=_wire_eligibility(cfg0.get("combiner"), False,
+                                         requested_wire),
+            id_wire_dtype=_id_wire_dtype(sum(rows), id_wire_mode)))
 
     return ShardedPlan(
         world_size=world, strategy=strategy, tp_buckets=buckets,

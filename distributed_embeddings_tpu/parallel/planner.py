@@ -21,6 +21,11 @@ import dataclasses
 import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+# the float wire formats of the exchange collectives (ISSUE 5) live in
+# ops/wire.py — the planner only records the REQUEST (validated against
+# that one registry), and lowering (parallel/plan.py) gates it per bucket
+from distributed_embeddings_tpu.ops.wire import (
+    WIRE_FORMATS as EXCHANGE_WIRE_FORMATS, default_exchange_wire)
 from distributed_embeddings_tpu.utils.initializers import ConcatInitializer
 
 Config = Dict[str, Any]
@@ -66,7 +71,8 @@ class DistEmbeddingStrategy:
                  data_parallel_threshold: Optional[int] = None,
                  gpu_embedding_size: Optional[int] = None,
                  input_hotness: Optional[Sequence[Optional[int]]] = None,
-                 hot_rows: Optional[int] = None):
+                 hot_rows: Optional[int] = None,
+                 exchange_wire: Optional[str] = None):
         if strategy not in ("auto", "basic", "memory_balanced",
                             "memory_optimized", "comm_balanced"):
             raise ValueError(f"Unsupported shard strategy {strategy}")
@@ -92,6 +98,17 @@ class DistEmbeddingStrategy:
         # time (parallel/plan.py lower_strategy).
         self.hot_rows = (default_hot_rows() if hot_rows is None
                          else max(0, int(hot_rows)))
+        # float exchange-wire request (ISSUE 5); None defers to the
+        # DET_EXCHANGE_WIRE environment default. Per-bucket eligibility
+        # (combiner, offload) is decided at lowering time
+        # (parallel/plan.py lower_strategy), like hot_rows above.
+        if exchange_wire is None:
+            exchange_wire = default_exchange_wire()
+        elif exchange_wire not in EXCHANGE_WIRE_FORMATS:
+            raise ValueError(
+                f"exchange_wire={exchange_wire!r}: expected one of "
+                f"{EXCHANGE_WIRE_FORMATS}")
+        self.exchange_wire = exchange_wire
 
         self.global_configs = []
         for emb in embeddings:

@@ -23,6 +23,7 @@ action remains (multi-process weight sync from process-local state, gradient
 postprocessing hooks). They are thin, documented, and jit-compatible.
 """
 
+import os
 from typing import Any, Callable, Optional
 
 import jax
@@ -120,7 +121,20 @@ def apply_updates(params, updates):
     return optax.apply_updates(params, updates)
 
 
-def make_train_step(loss_fn: Callable, optimizer, donate: bool = True,
+def default_donate() -> bool:
+    """Default for the train-step factories' ``donate`` argument:
+    ``DET_STEP_DONATE`` (unset/'1' -> True). The escape hatch exists for
+    environments where donated executables cannot be trusted end to end —
+    tests/conftest.py sets '0' because jaxlib 0.4.36 XLA:CPU intermittently
+    mis-executes DONATED executables loaded from the persistent
+    compilation cache (see compat.install_cpu_donation_cache_guard);
+    undonated steps are numerically identical, they just update out of
+    place."""
+    return os.environ.get("DET_STEP_DONATE", "1") != "0"
+
+
+def make_train_step(loss_fn: Callable, optimizer,
+                    donate: Optional[bool] = None,
                     param_shardings: Any = None):
     """Build the canonical jitted SPMD train step.
 
@@ -129,7 +143,8 @@ def make_train_step(loss_fn: Callable, optimizer, donate: bool = True,
         this is what makes replicated-param grads come out averaged, the
         reference's hvd.allreduce(average) semantics :1260).
       optimizer: optax optimizer (or DistributedOptimizer).
-      donate: donate params/opt_state buffers (in-place update on TPU).
+      donate: donate params/opt_state buffers (in-place update on TPU);
+        None defers to `default_donate()` (the DET_STEP_DONATE default).
       param_shardings: optional full params-tree sharding pytree, pinned on
         the step's params output (keeps placement stable across steps).
 
@@ -142,6 +157,8 @@ def make_train_step(loss_fn: Callable, optimizer, donate: bool = True,
         params = apply_updates(params, updates)
         return params, opt_state, loss
 
+    if donate is None:
+        donate = default_donate()
     donate_argnums = (0, 1) if donate else ()
     out_shardings = ((param_shardings, None, None)
                      if param_shardings is not None else None)
@@ -166,7 +183,8 @@ def _merge_dense(dense, params):
 
 def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
                            dense_optimizer=None, strategy: str = "auto",
-                           donate: bool = True, fold_sort: bool = True):
+                           donate: Optional[bool] = None,
+                           fold_sort: bool = True):
     """Build a train step whose embedding-table updates are row-wise sparse.
 
     This is the TPU-native analogue of the reference's full sparse training
@@ -285,6 +303,8 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
     # jit is load-bearing, not just speed: memory-kind placement (offloaded
     # pinned-host buckets) only propagates from concrete input shardings at
     # a top-level jit boundary; donation lets XLA update tables in place.
+    if donate is None:
+        donate = default_donate()
     if not off_buckets:
         core = jax.jit(step_fn, donate_argnums=(0, 1) if donate else ())
 

@@ -110,6 +110,26 @@ class LatencyHistogram:
         self._total += s
         self._max = max(self._max, s)
 
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Fold another histogram's counts into this one (in place;
+        returns self for chaining). Lets per-rep/per-stage histograms
+        aggregate into one distribution — e.g. the ingest bench's
+        per-stage timings across interleaved repetitions — instead of
+        only the last rep surviving. Bucket layouts must match exactly
+        (same lo/hi/bins_per_decade): merging differently-edged
+        histograms would silently misfile counts."""
+        if (self.lo, self.bins, self._ratio) != (other.lo, other.bins,
+                                                 other._ratio):
+            raise ValueError(
+                "cannot merge LatencyHistograms with different bucket "
+                f"layouts: (lo={self.lo}, bins={self.bins}, "
+                f"ratio={self._ratio}) vs (lo={other.lo}, "
+                f"bins={other.bins}, ratio={other._ratio})")
+        self._counts += other._counts
+        self._total += other._total
+        self._max = max(self._max, other._max)
+        return self
+
     @property
     def count(self) -> int:
         return int(self._counts.sum())

@@ -253,6 +253,12 @@ class IngestPipeline:
         p95_ms, p99_ms, max_ms}} — `read` is the implicit source stage."""
         return {n: h.summary() for n, h in self._hists.items()}
 
+    def stage_histograms(self) -> dict:
+        """The live per-stage `LatencyHistogram` objects — callers that
+        aggregate across runs (`LatencyHistogram.merge`, e.g. bench reps)
+        read these rather than the summarized dicts."""
+        return dict(self._hists)
+
     def bottleneck(self) -> Optional[str]:
         """Name of the slowest stage by mean wall time (None before any
         item completed) — the stage whose rate bounds pipelined throughput."""
@@ -292,6 +298,9 @@ class SerialPipeline:
 
     def stage_summaries(self) -> dict:
         return {n: h.summary() for n, h in self._hists.items()}
+
+    def stage_histograms(self) -> dict:
+        return dict(self._hists)
 
 
 def staged_batches(data: Iterable, stage: Optional[Callable] = None,

@@ -20,7 +20,8 @@ from typing import Any, Callable, NamedTuple, Sequence
 import jax
 
 __all__ = ["BenchResult", "benchmark", "benchmark_batches", "trace",
-           "annotate", "fetch_sync", "hlo_op_counts"]
+           "annotate", "fetch_sync", "hlo_op_counts",
+           "hlo_collective_bytes"]
 
 
 def hlo_op_counts(lowered, ops: Sequence[str] = ("sort", "scatter", "gather",
@@ -48,6 +49,63 @@ def hlo_op_counts(lowered, ops: Sequence[str] = ("sort", "scatter", "gather",
     text = lowered if isinstance(lowered, str) else lowered.as_text()
     return {op: len(re.findall(rf'stablehlo\.{re.escape(op)}\b', text))
             for op in ops}
+
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+                "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+                "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1}
+
+_COLLECTIVES = ("ragged_all_to_all", "all_to_all", "all_gather",
+                "reduce_scatter", "collective_permute")
+
+
+def hlo_collective_bytes(lowered, collectives=_COLLECTIVES) -> dict:
+    """Sum the operand bytes of each collective op in a lowered program,
+    split by element dtype — the byte-level twin of `hlo_op_counts` and
+    the static audit behind the wire-compression claim (ISSUE 5,
+    docs/perf_model.md "Wire compression"): whether the compiled step's
+    exchange operands actually narrowed is decided at trace time, so a
+    bf16-wire regression is catchable on any backend, no hardware.
+
+    Only the FIRST operand of each op is counted (the payload; e.g.
+    `ragged_all_to_all`'s five metadata operands are bookkeeping).
+    Shapes inside shard_map bodies are per-device — ratios between two
+    lowerings of the same program are what the audit asserts, not
+    absolute fleet bytes.
+
+    Args:
+      lowered: ``jax.jit(f).lower(...)`` result or its ``.as_text()``.
+      collectives: StableHLO op mnemonics to scan.
+
+    Returns {op: {dtype: bytes}, "total": {dtype: bytes},
+    "float_bytes": int, "int_bytes": int} — float_bytes aggregates
+    f64/f32/bf16/f16 payloads (the compressible activation/weight wire),
+    int_bytes the id wire.
+    """
+    import re
+    text = lowered if isinstance(lowered, str) else lowered.as_text()
+    out = {op: {} for op in collectives}
+    total: dict = {}
+    pat = re.compile(
+        r'"?stablehlo\.(' + "|".join(map(re.escape, collectives))
+        + r')"?.*?:\s*\(tensor<([^>]+)>', re.DOTALL)
+    for m in pat.finditer(text):
+        op, sig = m.group(1), m.group(2)
+        parts = sig.split("x")
+        dtype = parts[-1]
+        elems = 1
+        for p in parts[:-1]:
+            elems *= int(p)
+        nbytes = elems * _DTYPE_BYTES.get(dtype, 4)
+        out[op][dtype] = out[op].get(dtype, 0) + nbytes
+        total[dtype] = total.get(dtype, 0) + nbytes
+    float_b = sum(v for k, v in total.items()
+                  if k in ("f64", "f32", "bf16", "f16", "f8"))
+    int_b = sum(v for k, v in total.items() if k.startswith(("i", "ui")))
+    out["total"] = total
+    out["float_bytes"] = float_b
+    out["int_bytes"] = int_b
+    return out
 
 
 def fetch_sync(out) -> float:

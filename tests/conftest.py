@@ -31,6 +31,26 @@ jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
+# jaxlib 0.4.36 XLA:CPU intermittently mis-executes persistent-cache-
+# LOADED executables that carry buffer donation (~1 in 5 loaded donated
+# train steps computes wrong numerics — see the guard's docstring for
+# the isolation evidence). Two-part mitigation:
+#   * the suite builds train steps WITHOUT donation (DET_STEP_DONATE=0,
+#     numerically identical, out-of-place update) so the expensive
+#     grad-of-shard_map step compiles stay safely cacheable — the cache
+#     is what keeps the tier-1 suite inside its time budget;
+#   * the compat guard below is the backstop for anything still donated
+#     (tests passing donate=True explicitly): those modules bypass the
+#     persistent cache and always compile fresh.
+os.environ["DET_STEP_DONATE"] = "0"
+
+from distributed_embeddings_tpu import compat  # noqa: E402
+
+assert compat.install_cpu_donation_cache_guard(), (
+    "persistent-cache donation guard failed to install; either disable "
+    "the compilation cache for this run or update the guard for this "
+    "jax version")
+
 
 def pytest_configure(config):
     config.addinivalue_line(

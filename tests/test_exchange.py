@@ -145,6 +145,39 @@ def test_exchange_padding_report_and_auto_strategy():
         g["exchanged_ids"] for g in rep_auto["groups"])
     assert all(g["f_max"] == max(g["features_per_rank"])
                for g in rep_auto["groups"])
+    # byte-level wire accounting (ISSUE 5): every group carries its wire
+    # formats and the id+activation byte totals; top-level sums agree
+    for g in rep_auto["groups"]:
+        for k in ("wire_dtype", "id_wire_dtype", "act_width", "act_bytes",
+                  "act_bytes_f32", "exchanged_bytes", "true_bytes",
+                  "weight_bytes_if_weighted"):
+            assert k in g, k
+        assert g["exchanged_bytes"] >= g["true_bytes"]
+    assert rep_auto["exchanged_bytes"] == sum(
+        g["exchanged_bytes"] for g in rep_auto["groups"])
+    assert rep_auto["true_bytes"] == sum(
+        g["true_bytes"] for g in rep_auto["groups"])
+    # default wire: no compression claimed, all-f32 buckets
+    assert rep_auto["act_wire_reduction"] == 1.0
+    assert set(rep_auto["wire_dtypes"].values()) <= {"f32"}
+    assert isinstance(rep_auto["id_narrowed_groups"], list)
+
+
+def test_exchange_report_bf16_wire_bytes():
+    """A bf16-wire layer's report must show the >= 1.9x activation-byte
+    reduction the acceptance gate audits, per bf16 bucket and in total."""
+    specs = [(96, 8, "sum"), (50, 8, "sum"), (100, 16, "sum"), (120, 8, "sum")]
+    dist, _ = make_dist(specs, exchange_wire="bf16",
+                        input_max_hotness=[4, 4, 4, 4])
+    rep = dist.exchange_padding_report()
+    assert all(g["wire_dtype"] == "bf16" for g in rep["groups"])
+    for g in rep["groups"]:
+        assert g["act_bytes_f32"] / g["act_bytes"] == pytest.approx(2.0)
+    assert rep["act_wire_reduction"] >= 1.9
+    # small vocabs: the id wire narrowed too, and the narrowing is
+    # visible per group
+    assert all(g["id_wire_dtype"] == "int16" for g in rep["groups"])
+    assert rep["id_narrowed_groups"] == list(range(len(rep["groups"])))
 
 
 def test_one_hot_auto_resolves_basic():

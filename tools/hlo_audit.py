@@ -1,4 +1,4 @@
-"""HLO op-count audit for the compiled tapped sparse train step.
+"""HLO op-count and collective-byte audit for the compiled train step.
 
 The sort-folding work (ISSUE 2, docs/perf_model.md "Sort folding") is a
 TRACE-TIME property: the folded step must contain at most one stablehlo.sort
@@ -9,15 +9,25 @@ the attribution artifact for the day a TPU window opens: if the measured
 step is slow AND the audit says the sort count regressed, the cause is
 already isolated.
 
+The collective-byte arm (ISSUE 5, "Wire compression") applies the same
+honest-accounting pattern to the exchange WIRE: it lowers the tapped
+sparse train step over an 8-device mesh at each wire format and sums the
+`all_to_all`/`all_gather`/`reduce_scatter` operand bytes from the
+StableHLO (`utils.profiling.hlo_collective_bytes`). The bf16 wire must
+shrink the float collective bytes of the compiled step by >= 1.9x vs the
+f32 wire, and the f32 (default) wire must contain ZERO bf16 collective
+operands — both assertable without a TPU.
+
 Usage:
   python tools/hlo_audit.py            # print one JSON line per arm
   python tools/hlo_audit.py --assert   # exit 1 if any folded arm exceeds
-                                       # its sort bound (CI gate)
+                                       # its sort bound, or the wire arm
+                                       # misses its byte bound (CI gate)
 
-Library use: ``audit_tapped_step(...)`` returns the counts for one
-configuration; bench.py embeds a compact audit in its JSON record
-(``hlo_sort_audit``) so every hardware measurement carries the op-count
-fingerprint of the step it timed.
+Library use: ``audit_tapped_step(...)`` / ``audit_exchange_bytes(...)``
+return the counts for one configuration; bench.py embeds compact audits
+in its JSON records (``hlo_sort_audit``, ``wire_hlo``) so every hardware
+measurement carries the op-count fingerprint of the step it timed.
 """
 
 import argparse
@@ -28,15 +38,19 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _build_model(vocab: int, width: int, combiner: str, hot_rows: int = 0):
+def _build_model(vocab: int, width: int, combiner: str, hot_rows: int = 0,
+                 tables: int = 1, mesh=None, exchange_wire=None):
+    """Minimal tapped model (the shape make_sparse_train_step expects)
+    around a DistributedEmbedding — THE one copy of this harness, shared
+    by the sort-count arms, the collective-byte wire arms, and
+    bench.py's --mode wire A/B (via _load_hlo_audit), so the audit and
+    the bench always lower the same program."""
     import jax.numpy as jnp
     from distributed_embeddings_tpu.layers.dist_model_parallel import (
         DistributedEmbedding)
     from distributed_embeddings_tpu.layers.embedding import Embedding
 
     class _Tapped:
-        """Minimal model shape make_sparse_train_step expects."""
-
         def __init__(self, emb):
             self.embedding = emb
 
@@ -50,8 +64,9 @@ def _build_model(vocab: int, width: int, combiner: str, hot_rows: int = 0):
             loss = jnp.mean((jnp.sum(x, axis=1) - labels.reshape(-1)) ** 2)
             return (loss, res) if return_residuals else loss
 
-    emb = DistributedEmbedding([Embedding(vocab, width, combiner=combiner)],
-                               mesh=None, hot_rows=hot_rows)
+    emb = DistributedEmbedding(
+        [Embedding(vocab, width, combiner=combiner) for _ in range(tables)],
+        mesh=mesh, hot_rows=hot_rows, exchange_wire=exchange_wire)
     return _Tapped(emb)
 
 
@@ -113,6 +128,95 @@ def audit_tapped_step(vocab: int = 30_000_000, width: int = 8,
     }
 
 
+def _ensure_world(n: int = 8) -> int:
+    """Request >= n virtual CPU devices (the wire-byte arms lower real
+    collectives, which a world-1 model never emits). Must run before the
+    backend initializes; returns the device count actually available."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    import jax
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:  # noqa: BLE001 - backend already up / older jax
+        pass
+    return len(jax.devices())
+
+
+def audit_exchange_bytes(wire: str = "f32", vocab: int = 4096,
+                         width: int = 32, tables: int = 8, batch: int = 16,
+                         hotness: int = 2, optimizer: str = "adagrad",
+                         world: int = 8) -> dict:
+    """Lower the tapped sparse train step over a `world`-device mesh at
+    one exchange-wire format and return its collective-byte accounting
+    (plus the per-group padding-report byte fields, so the static claim
+    and the compiled HLO can be cross-checked in one record)."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_embeddings_tpu.parallel.mesh import create_mesh
+    from distributed_embeddings_tpu.training import make_sparse_train_step
+    from distributed_embeddings_tpu.utils.profiling import (
+        hlo_collective_bytes, hlo_op_counts)
+
+    devs = jax.devices()
+    if len(devs) < world:
+        return {"wire": wire, "skipped":
+                f"need {world} devices for the meshed lowering, "
+                f"have {len(devs)}"}
+    mesh = create_mesh(devs[:world])
+    model = _build_model(vocab, width, "sum", tables=tables, mesh=mesh,
+                         exchange_wire=wire)
+    emb = model.embedding
+    init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.01)
+    params = {"embedding": emb.init(jax.random.PRNGKey(0))}
+    state = init_fn(params)
+    num = jnp.zeros((batch, 1), jnp.float32)
+    cats = [jnp.zeros((batch, hotness), jnp.int32) for _ in range(tables)]
+    lab = jnp.zeros((batch,), jnp.float32)
+    lowered = jax.jit(step_fn).lower(params, state, num, cats, lab)
+    text = lowered.as_text()
+    bytes_ = hlo_collective_bytes(text)
+    rep = emb.exchange_padding_report(hotness=[hotness] * tables)
+    return {
+        "wire": wire, "optimizer": optimizer, "world": world,
+        "vocab": vocab, "width": width, "tables": tables, "batch": batch,
+        "hotness": hotness,
+        "collective_float_bytes": bytes_["float_bytes"],
+        "collective_int_bytes": bytes_["int_bytes"],
+        "collective_bytes_by_dtype": bytes_["total"],
+        "report_act_bytes": rep["act_bytes"],
+        "report_act_bytes_f32": rep["act_bytes_f32"],
+        "report_act_wire_reduction": round(rep["act_wire_reduction"], 3),
+        "report_exchanged_bytes": rep["exchanged_bytes"],
+        "report_true_bytes": rep["true_bytes"],
+        "id_narrowed_groups": rep["id_narrowed_groups"],
+        **{f"hlo_{k}": v for k, v in hlo_op_counts(text).items()},
+    }
+
+
+# minimum float-collective-byte shrink the bf16 wire must show vs f32 on
+# the same lowered step — the wire moves half the bits, so the compiled
+# ratio is 2.0 minus whatever small float traffic is not behind the seam
+WIRE_BYTE_MIN_REDUCTION = 1.9
+
+
+def wire_byte_arms(**kw) -> list:
+    """The f32-vs-bf16 collective-byte A/B records (+ derived reduction
+    stamped on the bf16 record)."""
+    base = audit_exchange_bytes(wire="f32", **kw)
+    comp = audit_exchange_bytes(wire="bf16", **kw)
+    if "skipped" not in comp and "skipped" not in base:
+        fb = base["collective_float_bytes"]
+        cb = comp["collective_float_bytes"]
+        comp["float_bytes_reduction_vs_f32"] = (
+            round(fb / cb, 3) if cb else None)
+        comp["min_reduction_required"] = WIRE_BYTE_MIN_REDUCTION
+        base["bf16_collective_bytes"] = (
+            base["collective_bytes_by_dtype"].get("bf16", 0))
+    return [base, comp]
+
+
 DEFAULT_ARMS = (
     # (optimizer, strategy, lookup_path, hot_rows)
     ("adagrad", "sort", None, 0),
@@ -136,11 +240,17 @@ def main(argv=None) -> int:
     p.add_argument("--width", type=int, default=8)
     p.add_argument("--unfolded", action="store_true",
                    help="also report the fold_sort=False baseline arms")
+    p.add_argument("--skip-wire", action="store_true",
+                   help="skip the meshed collective-byte wire arms")
     args = p.parse_args(argv)
 
     import jax
     jax.config.update("jax_platforms",
                       os.environ.get("JAX_PLATFORMS") or "cpu")
+    # the wire-byte arms lower over an 8-device mesh; virtual devices
+    # must be requested BEFORE the first backend touch below
+    if not args.skip_wire:
+        _ensure_world(8)
     failures = []
     for optimizer, strategy, lookup, hot_rows in DEFAULT_ARMS:
         folds = (True, False) if args.unfolded else (True,)
@@ -153,9 +263,25 @@ def main(argv=None) -> int:
                 rec["over_bound"] = True
                 failures.append(rec)
             print(json.dumps(rec), flush=True)
+    if not args.skip_wire:
+        arms = wire_byte_arms()
+        for rec in arms:
+            print(json.dumps(rec), flush=True)
+        base, comp = arms
+        if "skipped" not in comp:
+            # the f32 default must move ZERO bf16 collective bytes (the
+            # bit-exactness contract) and the bf16 wire must shrink the
+            # float collective bytes of the SAME step by >= 1.9x
+            if base.get("bf16_collective_bytes"):
+                base["over_bound"] = True
+                failures.append(base)
+            red = comp.get("float_bytes_reduction_vs_f32")
+            if red is None or red < WIRE_BYTE_MIN_REDUCTION:
+                comp["over_bound"] = True
+                failures.append(comp)
     if args.do_assert and failures:
-        print(f"hlo_audit: {len(failures)} folded arm(s) exceed the sort "
-              "bound", file=sys.stderr)
+        print(f"hlo_audit: {len(failures)} arm(s) exceed their bound "
+              "(sort count or collective bytes)", file=sys.stderr)
         return 1
     return 0
 
