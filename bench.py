@@ -389,23 +389,43 @@ def zipf_sampler(vocab: int, alpha: float, rng):
 
 def run_serve_bench(requests: int = 128, batch: int = 64,
                     capacity: int = 1024, alpha: float = 1.2,
-                    promote_threshold: int = 2, seed: int = 0) -> dict:
+                    promote_threshold: int = 2, seed: int = 0,
+                    updater_steps: int = 24, publish_every: int = 4,
+                    train_batch: int = 64) -> dict:
     """Serving benchmark: InferenceEngine + MicroBatcher over a synthetic
     model with a host-offloaded bucket, fed a zipfian id stream of
     variable-size requests. Reports throughput, HBM-cache hit rate, batch
     occupancy and latency percentiles. Runs on any backend, including
-    single-device CPU (the tier-1 smoke path)."""
+    single-device CPU (the tier-1 smoke path).
+
+    Concurrent-updater arm (ISSUE 6, on by default — `updater_steps=0`
+    disables): a background thread trains a SECOND layer instance of the
+    same plan on the same zipfian distribution and publishes row-delta
+    files every `publish_every` steps through a `TableStore`
+    (first publish = full snapshot); the serving loop polls and applies
+    them BETWEEN request batches while the percentile clock runs. The
+    record then measures the streaming path end to end: delta bytes vs
+    one full table copy (`serve_delta_full_ratio` — the ≤ 10% claim at
+    these touched-row rates), delta-apply row throughput, version/second
+    staleness, version monotonicity, and final bit-exact parity between
+    the consumer's tables and the publisher's
+    (`serve_update_parity_max_dev`)."""
     from distributed_embeddings_tpu.layers.embedding import Embedding
     from distributed_embeddings_tpu.layers.dist_model_parallel import (
         DistributedEmbedding)
     from distributed_embeddings_tpu.serving import InferenceEngine, MicroBatcher
+    from distributed_embeddings_tpu.store import TableStore
 
     rng = np.random.RandomState(seed)
     # one fused width-32 bucket; the 20k/8k tables blow a 16k-element budget
     specs = [(20000, 32), (8000, 32), (200, 32), (100, 32)]
-    dist = DistributedEmbedding(
-        [Embedding(v, w, combiner="sum") for v, w in specs],
-        gpu_embedding_size=16 * 1024)
+
+    def build():
+        return DistributedEmbedding(
+            [Embedding(v, w, combiner="sum") for v, w in specs],
+            gpu_embedding_size=16 * 1024)
+
+    dist = build()
     if not dist._offload_enabled:
         return {"serve_error": "backend exposes no host memory space"}
     params = dist.init(jax.random.PRNGKey(seed))
@@ -414,6 +434,74 @@ def run_serve_bench(requests: int = 128, batch: int = 64,
     engine.warmup([batch])
     batcher = MicroBatcher(engine, max_batch=batch)
     samplers = [zipf_sampler(v, alpha, rng) for v, _ in specs]
+
+    # ---- concurrent updater: second layer instance (same plan; separate
+    # instance so the trainer's trace-time state never races the serving
+    # forward's offload_lookup_scope), same starting weights
+    updater = None
+    if updater_steps > 0:
+        import tempfile
+        import threading
+        from distributed_embeddings_tpu.training import (
+            make_sparse_train_step)
+
+        class _Tapped:
+            def __init__(self, emb):
+                self.embedding = emb
+
+            def loss_fn(self, p, numerical, cats, labels, taps=None,
+                        return_residuals=False):
+                out = self.embedding(p["embedding"], list(cats), taps=taps,
+                                     return_residuals=return_residuals)
+                outs, res = out if return_residuals else (out, None)
+                x = jnp.concatenate(
+                    [o.reshape(o.shape[0], -1) for o in outs], axis=1)
+                loss = jnp.mean(
+                    (jnp.sum(x, axis=1) - labels.reshape(-1)) ** 2)
+                return (loss, res) if return_residuals else loss
+
+        t_dist = build()
+        t_model = _Tapped(t_dist)
+        t_params = {"embedding": t_dist.set_weights(
+            dist.get_weights(engine.store.params))}
+        init_fn, step_fn = make_sparse_train_step(t_model, "adagrad",
+                                                  lr=0.05)
+        t_state = init_fn(t_params)
+        pub_store = TableStore(t_dist, t_params["embedding"],
+                               t_state["emb"])
+        pub_dir = tempfile.mkdtemp(prefix="det_stream_")
+        t_rng = np.random.RandomState(seed + 1)
+        t_samplers = [zipf_sampler(v, alpha, t_rng) for v, _ in specs]
+        pub_infos = []
+        pub_err = []
+
+        # first publish (the snapshot anchor) + consumer sync BEFORE the
+        # clock: cold-start compile/copy must not pollute the percentiles
+        pub_store.commit(t_params["embedding"], t_state["emb"])
+        pub_infos.append(pub_store.publish(pub_dir))
+        engine.poll_updates(pub_dir)
+
+        def run_updater():
+            nonlocal t_params, t_state
+            try:
+                for step in range(updater_steps):
+                    cats = [jnp.asarray(s(train_batch).reshape(-1, 1))
+                            for s in t_samplers]
+                    labels = jnp.asarray(
+                        t_rng.randn(train_batch).astype(np.float32))
+                    pub_store.observe(cats)
+                    t_params, t_state, _ = step_fn(
+                        t_params, t_state, jnp.zeros((train_batch, 1)),
+                        cats, labels)
+                    if (step + 1) % publish_every == 0 \
+                            or step + 1 == updater_steps:
+                        pub_store.commit(t_params["embedding"],
+                                         t_state["emb"])
+                        pub_infos.append(pub_store.publish(pub_dir))
+            except Exception as e:  # noqa: BLE001 - surfaced in the record
+                pub_err.append(f"{type(e).__name__}: {e}")
+
+        updater = threading.Thread(target=run_updater, daemon=True)
 
     def request():
         n = int(rng.randint(1, max(batch // 2, 2)))
@@ -430,6 +518,8 @@ def run_serve_bench(requests: int = 128, batch: int = 64,
     base = engine.cache_stats()
     h0, m0 = base["hits"], base["misses"]
 
+    if updater is not None:
+        updater.start()
     rows = 0
     last = None
     t0 = time.perf_counter()
@@ -439,6 +529,8 @@ def run_serve_bench(requests: int = 128, batch: int = 64,
         rows += n
         if (i + 1) % 4 == 0:
             last = batcher.flush() or last
+            if updater is not None:
+                engine.poll_updates(pub_dir)   # async delta consumption
     last = batcher.flush() or last
     # fetch-sync on the last materialized result BEFORE stopping the clock
     # (async dispatch would otherwise inflate throughput; block_until_ready
@@ -450,7 +542,7 @@ def run_serve_bench(requests: int = 128, batch: int = 64,
     end = engine.cache_stats()
     lookups = (end["hits"] - h0) + (end["misses"] - m0)
     steady_hit_rate = round((end["hits"] - h0) / lookups, 4) if lookups else 0.0
-    return {
+    record = {
         "metric": "serve_synthetic_offload_zipf",
         "backend": jax.devices()[0].platform,
         "serve_requests": requests,
@@ -469,6 +561,56 @@ def run_serve_bench(requests: int = 128, batch: int = 64,
         "serve_cache": engine.cache_stats(),
         "git_sha": _git_sha(),
     }
+    if updater is not None:
+        updater.join()
+        engine.poll_updates(pub_dir)    # drain whatever published last
+        ustats = engine.update_stats(pub_dir)
+        # final parity: the consumer's merged tables must equal the
+        # publisher's bit for bit at the drained version
+        dev = 0.0
+        for a, b in zip(pub_store.get_weights(),
+                        engine.store.get_weights()):
+            dev = max(dev, float(np.max(np.abs(a - b))))
+        deltas = [i for i in pub_infos if i["kind"] == "delta"]
+        full_bytes = pub_store.full_table_bytes()
+        d_mean = (float(np.mean([i["bytes"] for i in deltas]))
+                  if deltas else 0.0)
+        record.update({
+            "serve_updater_steps": updater_steps,
+            "serve_publish_every": publish_every,
+            "serve_train_batch": train_batch,
+            "serve_updates_published": len(pub_infos),
+            "serve_updates_applied": ustats.get("applied", 0),
+            # the DELTA count is the streaming-path gate: the pre-clock
+            # snapshot sync alone must never satisfy it
+            "serve_updates_applied_deltas": ustats.get("applied_deltas", 0),
+            "serve_full_table_bytes": full_bytes,
+            "serve_delta_bytes_mean": int(d_mean),
+            "serve_delta_bytes_total": int(sum(i["bytes"]
+                                               for i in deltas)),
+            "serve_delta_rows_mean": (int(np.mean([i["rows"]
+                                                   for i in deltas]))
+                                      if deltas else 0),
+            # the ≤ 10% acceptance number: mean delta bytes per publish
+            # over one full-table copy, at this workload's touched rates
+            "serve_delta_full_ratio": round(d_mean / full_bytes, 5),
+            "serve_delta_apply_rows_per_sec":
+                ustats.get("apply_rows_per_sec", 0),
+            "serve_staleness_versions_max":
+                ustats.get("staleness_versions_max", 0),
+            "serve_staleness_versions_mean":
+                ustats.get("staleness_versions_mean", 0.0),
+            "serve_staleness_s_max": ustats.get("staleness_s_max", 0.0),
+            "serve_staleness_s_mean": ustats.get("staleness_s_mean", 0.0),
+            "serve_version_monotonic": ustats.get("version_monotonic",
+                                                  False),
+            "serve_update_parity_max_dev": dev,
+        })
+        if pub_err:
+            record["serve_updater_error"] = pub_err[0][:300]
+        import shutil
+        shutil.rmtree(pub_dir, ignore_errors=True)   # snapshots are MBs
+    return record
 
 
 def serve_main(argv=None) -> int:
@@ -482,13 +624,19 @@ def serve_main(argv=None) -> int:
     p.add_argument("--alpha", type=float, default=1.2)
     p.add_argument("--promote_threshold", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--updater_steps", type=int, default=24,
+                   help="concurrent train-publish-consume arm (ISSUE 6): "
+                        "background training steps; 0 disables")
+    p.add_argument("--publish_every", type=int, default=4)
+    p.add_argument("--train_batch", type=int, default=64)
     args = p.parse_args(argv)
     if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
         jax.config.update("jax_platforms", "cpu")
     record = run_serve_bench(
         requests=args.requests, batch=args.batch, capacity=args.capacity,
         alpha=args.alpha, promote_threshold=args.promote_threshold,
-        seed=args.seed)
+        seed=args.seed, updater_steps=args.updater_steps,
+        publish_every=args.publish_every, train_batch=args.train_batch)
     print(json.dumps(record))
     return 0 if "serve_error" not in record else 1
 

@@ -37,6 +37,11 @@ from distributed_embeddings_tpu.serving import (
     InferenceEngine,
     MicroBatcher,
 )
+from distributed_embeddings_tpu import store
+from distributed_embeddings_tpu.store import (
+    DeltaConsumer,
+    TableStore,
+)
 
 __all__ = [
     "__version__",
@@ -57,4 +62,7 @@ __all__ = [
     "InferenceEngine",
     "HotRowCache",
     "MicroBatcher",
+    "store",
+    "TableStore",
+    "DeltaConsumer",
 ]

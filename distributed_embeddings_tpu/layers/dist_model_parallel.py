@@ -796,7 +796,7 @@ class DistributedEmbedding:
         return res
 
     def exchange_padding_report(self, hotness=None,
-                                hot_hit_rate=None) -> dict:
+                                hot_hit_rate=None, batch: int = 1) -> dict:
         """Static accounting of the dp->mp id-exchange volume.
 
         The exchange sends one dense [world, f_max, k] id block per
@@ -841,14 +841,32 @@ class DistributedEmbedding:
         transpose moves the same activation volume again (same ratio);
         weighted inputs add `weight_bytes_if_weighted` per group.
 
+        Touched-row accounting (ISSUE 6): every group also carries
+        `touched_rows_per_step` — the dedup'd post-sentinel-mask ids the
+        sparse update actually writes per step at global batch size
+        ``batch`` (hot-HIT lanes are sentinel-masked and skip the
+        canonical scatter, so the post-hot volume is the base; the
+        dedup bound is the bucket's total row count) — and
+        `delta_bytes_per_step`, the row-delta size model built on it:
+        ``(touched + republished hot hits) * (8 id bytes + 4 * width
+        payload bytes)`` — hot-HIT rows skip the canonical scatter but
+        still move the replicated hot shard, so the published delta
+        republishes their merged values (bounded by the hot capacity).
+        This is the numerator of the delta-vs-full-copy ratio the
+        weight-streaming store publishes at (docs/perf_model.md
+        "Weight streaming").
+
         Args:
           hotness: per-tp-input hotness override; defaults to the layer's
             input_max_hotness hints (unhinted inputs count as 1).
           hot_hit_rate: hot-shard hit-rate override (see above).
+          batch: global batch size for the touched-row/delta-size model
+            (default 1 = per-sample accounting, matching the id fields).
         Returns {"groups": [...], "true_ids", "exchanged_ids", "ratio",
         "exchanged_bytes", "true_bytes", "act_bytes", "act_bytes_f32",
         "act_wire_reduction", "wire_dtypes", "id_narrowed_groups",
-        "hot_hit_ids", "true_ids_post_hot", "hot_hit_rates"}.
+        "hot_hit_ids", "true_ids_post_hot", "hot_hit_rates",
+        "touched_rows_per_step", "delta_bytes_per_step"}.
         """
         tp_inputs = self.strategy.input_groups[1]
         if hotness is None:
@@ -872,6 +890,7 @@ class DistributedEmbedding:
         key = tuple((int(h), False) for h in hotness)
         groups, _ = self._exchange_groups_for_key(key)
         report, true_tot, ex_tot, hot_tot = [], 0, 0, 0
+        touched_tot, delta_bytes_tot = 0, 0
         ex_bytes_tot, true_bytes_tot = 0, 0
         act_bytes_tot, act_bytes_f32_tot = 0, 0
         id_narrowed = []
@@ -924,6 +943,24 @@ class DistributedEmbedding:
                 hot_tot += hot_ids
                 entry["hot_hit_ids"] = hot_ids
                 entry["true_ids_post_hot"] = true_ids - hot_ids
+            # touched-row / delta-size model (ISSUE 6): rows this group's
+            # sparse update writes per step — post-hot ids scaled to the
+            # batch, dedup-bounded by the bucket's total rows. The BYTE
+            # model adds the hot-HIT rows back in: they skip the
+            # canonical scatter but move the replicated hot shard, and
+            # the published delta republishes their MERGED values
+            # (touched_row_keys includes them) — bounded by the hot
+            # shard's capacity, the most rows the merged view can move.
+            post_hot = entry.get("true_ids_post_hot", true_ids)
+            touched = min(int(batch) * post_hot,
+                          self.world_size * max(bucket.rows_max, 1))
+            hot_pub = min(int(batch) * entry.get("hot_hit_ids", 0),
+                          bucket.hot_rows)
+            entry["touched_rows_per_step"] = touched
+            entry["delta_bytes_per_step"] = (
+                (touched + hot_pub) * (8 + 4 * bucket.width))
+            touched_tot += touched
+            delta_bytes_tot += entry["delta_bytes_per_step"]
             report.append(entry)
         return {"groups": report, "true_ids": true_tot,
                 "exchanged_ids": ex_tot,
@@ -943,6 +980,8 @@ class DistributedEmbedding:
                 "hot_hit_ids": hot_tot,
                 "true_ids_post_hot": true_tot - hot_tot,
                 "hot_hit_rates": {b: rate_for(b) for b in self._hot_buckets},
+                "touched_rows_per_step": touched_tot,
+                "delta_bytes_per_step": delta_bytes_tot,
                 "exchange_paths": dict(self._exchange_path_taken)}
 
     def residual_sort_scope(self, spec):
@@ -3067,6 +3106,119 @@ class DistributedEmbedding:
                              residual_sort=residual_sort)
 
     # ------------------------------------- hot-row admission + consistency
+    @staticmethod
+    def _host_flat_ids(x) -> np.ndarray:
+        """Flatten one apply-style input (dense ids, (ids, weights)
+        tuple, RaggedIds, SparseIds) to its locally-visible id stream as
+        int64 numpy — the shared host-side mirror feeding both hot-row
+        admission (`observe_hot_ids`) and touched-row accounting
+        (`touched_row_keys`)."""
+
+        def _local_parts(arr):
+            # multi-process staged batches are global jax.Arrays that are
+            # NOT fully addressable — device_get would raise. The local
+            # batch shard is both available and exactly what this process
+            # should observe (sync_hot_rows reconciles the per-process
+            # counters by broadcasting the admitted set from process 0).
+            if getattr(arr, "is_fully_addressable", True):
+                return np.asarray(jax.device_get(arr)), 0
+            shards = sorted(arr.addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            start = shards[0].index[0].start or 0
+            return np.concatenate(
+                [np.asarray(s.data).reshape(-1) for s in shards]), start
+
+        if (isinstance(x, tuple) and len(x) == 2
+                and not isinstance(x, RaggedIds)):
+            x = x[0]
+        if isinstance(x, RaggedIds):
+            # values past row_splits[-1] are padding by contract —
+            # counting them would attribute phantom lookups to row 0.
+            # Trim to the flat span the locally visible row_splits
+            # cover: fully-addressable, that is exactly [0, n); on a
+            # sharded batch it is always real values (padding lives
+            # past the LAST split), at worst dropping a boundary
+            # sliver of a row that straddles the shard edge — fine
+            # for frequency statistics.
+            vals, v0 = _local_parts(x.values)
+            sp, _ = _local_parts(x.row_splits)
+            sp = sp.reshape(-1)
+            lo, hi = int(sp[0]), int(sp[-1])
+            x = vals.reshape(-1)[max(lo - v0, 0):max(hi - v0, 0)]
+        elif isinstance(x, SparseIds):
+            x = x.values
+        if not isinstance(x, np.ndarray):
+            x = _local_parts(x)[0]
+        return x.reshape(-1).astype(np.int64)
+
+    def touched_row_keys(self, inputs) -> dict:
+        """Host-side mirror of the rows one batch's sparse update may
+        write (the weight-streaming producer's accounting, ISSUE 6):
+        {("tp", b): sorted unique int64 flat keys
+        (``rank * rows_max + row`` — the `HotRowCache`/hot-shard key
+        space), ("row", t): sorted unique GLOBAL row ids}.
+
+        The sets are deliberately a tight SUPERSET of the rows the
+        update writes: sentinel-masked OOB ids are excluded (the update
+        drops them), while hot-HIT lanes are included — they skip the
+        canonical scatter but move the replicated hot shard, i.e. the
+        MERGED row value a delta must republish. Zero-weight lanes are
+        included too (lazy adam decays moments on id presence). A
+        superset is the safe direction for SET-payload deltas: applying
+        an unchanged row is a no-op, missing a changed one is silent
+        divergence. dp tables never appear — they train densely and are
+        published whole."""
+        if len(inputs) != self._n_inputs:
+            raise ValueError(
+                f"Expected {self._n_inputs} inputs, got {len(inputs)}")
+        seg_rows = {(pl.bucket, pl.rank, pl.row_offset): pl.rows
+                    for pl in self.plan.tp_placements}
+        per: dict = {}
+        for pos, i in enumerate(self.strategy.input_groups[1]):
+            ids = self._host_flat_ids(inputs[i])
+            for (rank, b, slot_idx) in self.plan.tp_input_slots[pos]:
+                bucket = self.plan.tp_buckets[b]
+                off = bucket.slots[rank][slot_idx].row_offset
+                rows = seg_rows.get((b, rank, off), 0)
+                rows_max = max(bucket.rows_max, 1)
+                v = ids[(ids >= 0) & (ids < rows)]
+                if len(v):
+                    per.setdefault(("tp", b), []).append(
+                        rank * rows_max + off + v)
+        for j, i in enumerate(self.strategy.input_groups[2]):
+            t = self.strategy.map_groups[2][j]
+            rt = self.plan.row_tables[t]
+            total = int(sum(rt.rows_per_rank))
+            ids = self._host_flat_ids(inputs[i])
+            v = ids[(ids >= 0) & (ids < total)]
+            if len(v):
+                per.setdefault(("row", t), []).append(v)
+        return {k: np.unique(np.concatenate(chunks))
+                for k, chunks in per.items()}
+
+    def hot_resident_rows(self, params) -> dict:
+        """{bucket: (sorted valid int64 keys [n], rows [n, w])} — the
+        AUTHORITATIVE hot-resident rows per hot bucket. This is the ONE
+        source both consistency consumers read (ISSUE 6): the
+        `get_weights` portable-dump overlay and the table store's
+        versioned `read_rows` — so a stale overlay after
+        `sync_hot_rows` cannot exist by construction (there is no second
+        derivation to drift). Empty dict on hot-less layers/params."""
+        out = {}
+        if not (self._hot_buckets and "hot" in params):
+            return out
+        for b in self._hot_buckets:
+            entry = params["hot"][b]
+            if entry is None:
+                continue
+            keys = np.asarray(jax.device_get(entry["ids"])) \
+                .astype(np.int64)
+            rows = np.asarray(jax.device_get(entry["rows"]))
+            valid = (keys >= 0) & (keys < self._hot_sentinel(b))
+            if valid.any():
+                out[b] = (keys[valid], rows[valid])
+        return out
+
     def _hot_tracker(self, b: int) -> HotnessTracker:
         tr = self._hot_trackers.get(b)
         if tr is None:
@@ -3091,20 +3243,6 @@ class DistributedEmbedding:
         if not self._hot_buckets:
             return {}
 
-        def _local_parts(arr):
-            # multi-process staged batches are global jax.Arrays that are
-            # NOT fully addressable — device_get would raise. The local
-            # batch shard is both available and exactly what this process
-            # should observe (sync_hot_rows reconciles the per-process
-            # counters by broadcasting the admitted set from process 0).
-            if getattr(arr, "is_fully_addressable", True):
-                return np.asarray(jax.device_get(arr)), 0
-            shards = sorted(arr.addressable_shards,
-                            key=lambda s: s.index[0].start or 0)
-            start = shards[0].index[0].start or 0
-            return np.concatenate(
-                [np.asarray(s.data).reshape(-1) for s in shards]), start
-
         per_bucket: dict = {b: [] for b in self._hot_buckets}
         hot_set = set(self._hot_buckets)
         # the device split only ever hits ids inside the lane's backing
@@ -3116,29 +3254,7 @@ class DistributedEmbedding:
                         for pl in self.plan.tp_placements if pl.bucket == b}
                     for b in self._hot_buckets}
         for pos, i in enumerate(self.strategy.input_groups[1]):
-            x = inputs[i]
-            if (isinstance(x, tuple) and len(x) == 2
-                    and not isinstance(x, RaggedIds)):
-                x = x[0]
-            if isinstance(x, RaggedIds):
-                # values past row_splits[-1] are padding by contract —
-                # counting them would attribute phantom lookups to row 0.
-                # Trim to the flat span the locally visible row_splits
-                # cover: fully-addressable, that is exactly [0, n); on a
-                # sharded batch it is always real values (padding lives
-                # past the LAST split), at worst dropping a boundary
-                # sliver of a row that straddles the shard edge — fine
-                # for frequency statistics.
-                vals, v0 = _local_parts(x.values)
-                sp, _ = _local_parts(x.row_splits)
-                sp = sp.reshape(-1)
-                lo, hi = int(sp[0]), int(sp[-1])
-                x = vals.reshape(-1)[max(lo - v0, 0):max(hi - v0, 0)]
-            elif isinstance(x, SparseIds):
-                x = x.values
-            if not isinstance(x, np.ndarray):
-                x = _local_parts(x)[0]
-            ids = x.reshape(-1).astype(np.int64)
+            ids = self._host_flat_ids(inputs[i])
             for (rank, b, slot_idx) in self.plan.tp_input_slots[pos]:
                 if b not in hot_set:
                     continue
@@ -3489,34 +3605,27 @@ class DistributedEmbedding:
         # hot-row overlay (ISSUE 4): while resident, the replicated hot
         # shard is authoritative for its rows (the canonical table stops
         # receiving their gradients) — merge them into the portable dump
-        # so get_weights is correct even without a prior sync_hot_rows
-        if self._hot_buckets and "hot" in params:
-            for b in self._hot_buckets:
-                entry = params["hot"][b]
-                if entry is None:
+        # so get_weights is correct even without a prior sync_hot_rows.
+        # The resident set comes from `hot_resident_rows`, the SAME
+        # single source the table store's versioned `read_rows` overlays
+        # from (ISSUE 6): both consumers see one derivation, so they
+        # cannot drift.
+        for b, (keys_v, rows_v) in self.hot_resident_rows(params).items():
+            rows_max = max(self.plan.tp_buckets[b].rows_max, 1)
+            w_idx = keys_v // rows_max
+            r_idx = keys_v % rows_max
+            for pl_ in self.plan.tp_placements:
+                if pl_.bucket != b:
                     continue
-                keys = np.asarray(jax.device_get(entry["ids"])) \
-                    .astype(np.int64)
-                rows = np.asarray(jax.device_get(entry["rows"]))
-                rows_max = max(self.plan.tp_buckets[b].rows_max, 1)
-                valid = (keys >= 0) & (keys < self._hot_sentinel(b))
-                if not valid.any():
+                m = ((w_idx == pl_.rank) & (r_idx >= pl_.row_offset)
+                     & (r_idx < pl_.row_offset + pl_.rows))
+                if not m.any():
                     continue
-                w_idx = keys[valid] // rows_max
-                r_idx = keys[valid] % rows_max
-                rows_v = rows[valid]
-                for pl_ in self.plan.tp_placements:
-                    if pl_.bucket != b:
-                        continue
-                    m = ((w_idx == pl_.rank) & (r_idx >= pl_.row_offset)
-                         & (r_idx < pl_.row_offset + pl_.rows))
-                    if not m.any():
-                        continue
-                    gtid = strat.table_groups[1][pl_.table_id]
-                    if not out[gtid].flags.writeable:
-                        out[gtid] = out[gtid].copy()
-                    out[gtid][r_idx[m] - pl_.row_offset,
-                              pl_.col_start:pl_.col_end] = rows_v[m]
+                gtid = strat.table_groups[1][pl_.table_id]
+                if not out[gtid].flags.writeable:
+                    out[gtid] = out[gtid].copy()
+                out[gtid][r_idx[m] - pl_.row_offset,
+                          pl_.col_start:pl_.col_end] = rows_v[m]
         return out
 
     def set_weights(self, weights: Sequence) -> dict:

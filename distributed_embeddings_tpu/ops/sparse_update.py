@@ -746,6 +746,16 @@ def host_apply_rows_inplace(kind: str, table, state, rep, sums, valid, lr,
     rep = np.ascontiguousarray(rep, dtype=np.int32)
     sums = np.ascontiguousarray(sums, dtype=np.float32)
     valid = np.ascontiguousarray(valid, dtype=np.float32)
+    if kind == "set":
+        # weight-streaming row SET (store/table_store.py delta apply):
+        # `sums` carries replacement row VALUES, not gradients — valid
+        # reps are unique, so a plain masked assignment is exact. Rides
+        # this seam so offloaded-bucket delta consumption shares the
+        # contiguity/dtype contract (and the shard-walk callers) of the
+        # optimizer applies; trivially bandwidth-bound, so no C++ twin.
+        ok_set = valid > 0.0
+        table[rep[ok_set]] = sums[ok_set]
+        return
     lib = None
     try:
         from ..native import loader as _native_loader

@@ -103,6 +103,9 @@ class HotRowCache:
         self._slots = self._put_slots()
         self._reader_cache: dict = {}
         self.refreshes = 0
+        # store version the residents were last refreshed/synced at
+        # (None until a store-routed refresh has run)
+        self.refreshed_version = None
 
     # tracker views — the host-side state lives on the shared tracker;
     # these names are the cache's public/test surface
@@ -226,13 +229,44 @@ class HotRowCache:
     def refresh(self, table: jax.Array) -> int:
         """Re-copy every resident row from `table` into the HBM slots —
         REQUIRED after anything mutates the offloaded table (see the
-        consistency contract in docs/serving.md). Returns rows refreshed."""
+        consistency contract in docs/serving.md). Returns rows refreshed.
+
+        Prefer `refresh_from(store)` where a `TableStore` owns the
+        tables: passing an array here re-derives the row source by hand,
+        which is exactly the two-path staleness seam the store closes."""
         resident = np.flatnonzero(self._slot_keys >= 0)
         if len(resident):
             rows = self._read_rows(table, self._slot_keys[resident])
             self._update_slots(resident, rows)
         self.refreshes += 1
         return int(len(resident))
+
+    def refresh_from(self, store) -> int:
+        """Re-copy every resident row through the table store's
+        versioned `read_rows` (ISSUE 6): the row source is the store's
+        CURRENT merged view by construction — a caller cannot hand this
+        path a stale table reference. Records the store version the
+        residents now reflect (`refreshed_version`)."""
+        resident = np.flatnonzero(self._slot_keys >= 0)
+        if len(resident):
+            rows = store.read_rows(self.bucket, self._slot_keys[resident])
+            self._update_slots(resident, rows)
+        self.refreshes += 1
+        self.refreshed_version = store.version
+        return int(len(resident))
+
+    def apply_rows(self, keys: np.ndarray, rows: np.ndarray) -> int:
+        """Delta-consumption fast path (ISSUE 6): update any RESIDENT
+        slots among `keys` with the given row payload — the values come
+        straight off the published wire (bit-exact copies of the
+        publisher's merged view), so no table read happens at all.
+        Counters and stats are untouched. Returns slots updated."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        slot = self._tracker.lookup_slots(keys, observe=False)
+        m = slot >= 0
+        if m.any():
+            self._update_slots(slot[m], np.asarray(rows)[m])
+        return int(m.sum())
 
     def invalidate(self) -> None:
         """Drop every resident row (hits resume only after re-admission)."""

@@ -20,10 +20,15 @@ apply-only wrapper that:
   * pads every request batch to the nearest prepared shape (a static-shape
     requirement on TPU) and slices the true rows back out.
 
-Consistency: the engine snapshots nothing — it reads whatever `params` it
-currently holds. After training mutates tables, call ``set_params(new)``;
-cached hot rows are STALE until ``refresh()`` re-copies them (see
-docs/serving.md for the contract).
+Consistency: the engine's embedding tables are OWNED by a versioned
+`TableStore` (ISSUE 6) — `predict` reads the store's current params, so
+every table mutation routes through one interface. Three update paths:
+``set_params(new)`` swaps whole pytrees (cached hot rows are STALE until
+``refresh()``, which re-reads residents through the store's versioned
+read); ``apply_delta(path)`` / ``poll_updates(dir)`` consume row-delta
+publications from a live training job in place — no restart, no
+full-table copy, HBM cache slots patched straight off the wire (see
+docs/serving.md "Weight streaming" for the contract).
 """
 
 import math
@@ -37,6 +42,7 @@ from distributed_embeddings_tpu.layers.dist_model_parallel import (
     DistributedEmbedding)
 from distributed_embeddings_tpu.serving.cache import (HotRowCache,
                                                       cached_group_lookup)
+from distributed_embeddings_tpu.store import DeltaConsumer, TableStore
 
 __all__ = ["InferenceEngine"]
 
@@ -93,6 +99,12 @@ class InferenceEngine:
                 and "opt_state" in params:
             params = params["params"]      # checkpoint dict: strip opt state
         self.params = params
+        # versioned ownership (ISSUE 6): the embedding tables live behind
+        # a TableStore — `refresh()` and delta consumption read/write
+        # through it, so serving can never hold a second derivation of
+        # the row state
+        self.store = TableStore(self.embedding, self._emb_params(params))
+        self._consumers: Dict[str, DeltaConsumer] = {}
 
         emb = self.embedding
         self.caches: Dict[int, HotRowCache] = {}
@@ -316,24 +328,78 @@ class InferenceEngine:
         return self._warmed
 
     def set_params(self, params, refresh: bool = False) -> None:
-        """Swap in new parameters (e.g. after training steps). Cached hot
-        rows still hold the OLD table values until `refresh()` — pass
-        refresh=True (or call it explicitly) whenever bit-exact serving
-        matters more than the swap latency."""
+        """Swap in new parameters (e.g. after training steps). The swap
+        routes through the table store (`TableStore.replace` — version
+        bump, delta chain broken: the next consumed stream file must be
+        a snapshot). Cached hot rows still hold the OLD table values
+        until `refresh()` — pass refresh=True (or call it explicitly)
+        whenever bit-exact serving matters more than the swap latency."""
         if isinstance(params, dict) and "params" in params \
                 and "opt_state" in params:
             params = params["params"]
         self.params = params
+        self.store.replace(self._emb_params(params))
         if refresh:
             self.refresh()
 
+    def _sync_store_params(self) -> None:
+        """Reflect the store's current (post-apply) param pytree into the
+        pytree `predict` feeds the compiled forward."""
+        if self._model is None:
+            self.params = self.store.params
+        else:
+            self.params = {**self.params, "embedding": self.store.params}
+
     def refresh(self) -> int:
-        """Re-copy every cached row from the current tables (the explicit
-        cache-consistency step after table mutation). Returns total rows
-        refreshed across buckets."""
-        emb_params = self._emb_params(self.params)
-        return sum(cache.refresh(emb_params["tp"][b])
-                   for b, cache in self.caches.items())
+        """Re-copy every cached row from the current tables through the
+        store's versioned read (the explicit cache-consistency step
+        after table mutation — a stale table reference cannot reach the
+        cache from here by construction). Returns total rows refreshed
+        across buckets."""
+        return sum(cache.refresh_from(self.store)
+                   for cache in self.caches.values())
+
+    def apply_delta(self, path: str) -> dict:
+        """Consume one published stream file (row delta or snapshot) in
+        place: the store applies it to the tables (HBM scatter / host
+        row set — no recompile, no full-table copy except for
+        snapshots), and resident HBM cache slots are patched straight
+        off the delta payload so cached serving stays bit-exact at the
+        new version. Returns the store's apply info."""
+        info = self.store.apply_published(path)
+        self._absorb_apply(info)
+        return info
+
+    def poll_updates(self, publish_dir: str) -> List[dict]:
+        """Apply every new stream file a training job has published into
+        `publish_dir` (chain order; snapshot fallback), patching caches
+        per file. Returns the applied infos; `update_stats(publish_dir)`
+        exposes the consumer's staleness accounting."""
+        consumer = self._consumers.get(publish_dir)
+        if consumer is None:
+            consumer = DeltaConsumer(self.store, publish_dir)
+            self._consumers[publish_dir] = consumer
+        infos = consumer.poll()
+        for info in infos:
+            self._absorb_apply(info)
+        return infos
+
+    def update_stats(self, publish_dir: str) -> dict:
+        consumer = self._consumers.get(publish_dir)
+        return consumer.stats() if consumer is not None else {}
+
+    def _absorb_apply(self, info: dict) -> None:
+        self._sync_store_params()
+        if info["kind"] == "snapshot":
+            # whole tables were rebuilt: every resident row re-reads
+            for cache in self.caches.values():
+                cache.refresh_from(self.store)
+            return
+        for b, cache in self.caches.items():
+            hit = info["payload"].get(("tp", b))
+            if hit is not None:
+                cache.apply_rows(*hit)
+                cache.refreshed_version = self.store.version
 
     def cache_stats(self) -> dict:
         """Aggregate + per-bucket cache statistics."""
@@ -346,4 +412,5 @@ class InferenceEngine:
                 "n_predicts": self.n_predicts,
                 "rows_served": self.rows_served,
                 "rows_padded": self.rows_padded,
+                "store_version": self.store.version,
                 "buckets": per}

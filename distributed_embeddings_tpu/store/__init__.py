@@ -1,0 +1,23 @@
+"""Versioned table store: train-to-serve weight streaming (ISSUE 6).
+
+One parameter store powering both subsystems (ROADMAP item 3): a
+training job owns its tables through a `TableStore`, publishes row-delta
+snapshots (dedup'd touched-row ids + row payloads + a monotonic version
+header) every N steps, and any number of serving replicas consume them
+in-place — no restart, no full-table copy. See docs/serving.md
+"Weight streaming" for the contract and the on-disk format.
+"""
+
+from distributed_embeddings_tpu.store.table_store import (DeltaChainError,
+                                                          DeltaConsumer,
+                                                          TableStore,
+                                                          restore_from_published,
+                                                          scan_published)
+
+__all__ = [
+    "DeltaChainError",
+    "DeltaConsumer",
+    "TableStore",
+    "restore_from_published",
+    "scan_published",
+]

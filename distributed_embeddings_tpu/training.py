@@ -344,7 +344,8 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         callbacks=(), eval_data=None, eval_every: int = 0,
         eval_steps: int = 16, log_every: int = 100, log_fn=print,
         stage=None, sync_every=None, preprocess=None, pipelined: bool = True,
-        pipeline_depth: int = 2, hot_sync_every: int = 0):
+        pipeline_depth: int = 2, hot_sync_every: int = 0,
+        store=None, publish_every: int = 0, publish_dir=None):
     """Minimal training-loop driver — the role the reference fills with
     Keras `model.fit` + `DistributedOptimizer` + callbacks
     (reference dist_model_parallel.py:1270-1326, synthetic main.py:104-114).
@@ -389,6 +390,18 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         and on the CPU backend (XLA:CPU's in-process collectives can
         deadlock when many steps are dispatched asynchronously), else 0
         (TPU: never block mid-run).
+      store / publish_every / publish_dir: weight streaming (ISSUE 6):
+        pass a `store.TableStore` over `params["embedding"]` and a
+        publish cadence to turn this run into a live publisher — every
+        step's touched-row keys accumulate host-side
+        (`store.observe`; per-step numpy work proportional to the
+        batch's unique ids — the price of delta completeness, unlike
+        the SAMPLED hot-admission feed below), and every
+        `publish_every` steps the loop commits the current pytrees and
+        writes the next row-delta file (first publish = full snapshot)
+        into `publish_dir` for `InferenceEngine.poll_updates` replicas.
+        Leftover steps publish once more at the end. Sparse path only.
+        History gains a 'published' list of publish infos.
       hot_sync_every: hot-row replication cadence (layers built with
         `hot_rows=`, sparse path only): every N steps the loop runs
         `sync_hot_rows(admit=True)` — write hot rows back to the
@@ -470,10 +483,25 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
     hot_active = (sparse and hot_sync_every
                   and getattr(hot_emb, "_hot_buckets", None))
     hot_observe_stride = max(1, hot_sync_every // 8) if hot_active else 0
+    publishing = bool(sparse and store is not None and publish_every)
+    if publishing and publish_dir is None:
+        raise ValueError("publish_every requires publish_dir")
+    steps_since_publish = 0
+
+    def publish_now():
+        drain()                     # params are about to be read host-side
+        store.commit(params["embedding"], opt_state["emb"])
+        history.setdefault("published", []).append(store.publish(publish_dir))
+
     try:
         for step in range(steps):
             batch = get_batch(step) if get_batch else next(it)
             numerical, cats, labels = batch
+            if publishing:
+                # EVERY step: the delta's key set must cover every row
+                # the update touches (a sampled feed would silently
+                # drop rows from the published view)
+                store.observe(list(cats))
             if hot_active:
                 if step % hot_observe_stride == 0:
                     hot_emb.observe_hot_ids(list(cats))
@@ -488,6 +516,11 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
                                               [jnp.asarray(c) for c in cats],
                                               jnp.asarray(labels))
             pending.append(loss)
+            if publishing:
+                steps_since_publish += 1
+                if steps_since_publish >= publish_every:
+                    publish_now()
+                    steps_since_publish = 0
             if sync_every and (step + 1) % sync_every == 0:
                 drain()                       # explicit lockstep barrier
             if log_every and step % log_every == 0:
@@ -519,6 +552,8 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         params = {**params, "embedding": p_emb}
         opt_state = {**opt_state, "emb": s_emb}
         history["hot_stats"] = hot_emb.hot_stats()
+    if publishing and steps_since_publish:
+        publish_now()               # leftover tail steps reach replicas too
     return params, opt_state, history
 
 

@@ -35,8 +35,9 @@ is the explicit consistency step that writes hot rows (and their
 optimizer-state rows) back into the canonical tables.
 """
 
+import json
 import os
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -47,6 +48,9 @@ __all__ = [
     "latest_step",
     "save_global_weights",
     "load_global_weights",
+    "save_row_delta",
+    "load_row_delta",
+    "load_row_delta_meta",
 ]
 
 
@@ -155,6 +159,46 @@ def save_global_weights(path: str, weights: Sequence[np.ndarray],
     for i, w in enumerate(weights):
         np.save(os.path.join(path, f"table_{i}.npy"), np.asarray(w))
     return path
+
+
+def save_row_delta(path: str, meta: dict, arrays: Dict[str, np.ndarray]
+                   ) -> str:
+    """One weight-streaming file (ISSUE 6): named numpy arrays plus a
+    JSON metadata header, in one uncompressed .npz (uncompressed so the
+    on-disk byte count IS the wire-byte accounting the delta-vs-full
+    model is built on, and loads are mmap-friendly).
+
+    Two kinds share the container (see store/table_store.py):
+      * kind='delta'    — per touched tp bucket / row table a
+        ``{kind}{idx}_keys`` int64 array (dedup'd flat row keys) and a
+        ``{kind}{idx}_rows`` f32 [n, width] payload of MERGED row
+        values, plus each dp table whole (``dp{j}_full``);
+      * kind='snapshot' — every table whole (``table{i}``), the
+        compaction/resync anchor.
+    `meta` must carry {"version", "base_version", "kind",
+    "published_at", "sig"} — `version` is the publisher's monotonic
+    store version, `base_version` the previous published version a
+    delta chains from (None for snapshots/first publish), `sig` the
+    per-table (input_dim, output_dim) list consumers verify."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    np.savez(path, __meta__=np.asarray(json.dumps(meta)), **arrays)
+    return path
+
+
+def load_row_delta(path: str) -> Tuple[dict, Dict[str, np.ndarray]]:
+    """Read a weight-streaming file: (meta dict, {name: array})."""
+    data = np.load(path, allow_pickle=False)
+    meta = json.loads(str(data["__meta__"]))
+    return meta, {k: data[k] for k in data.files if k != "__meta__"}
+
+
+def load_row_delta_meta(path: str) -> dict:
+    """Read ONLY the metadata header of a weight-streaming file — npz
+    members load lazily, so a consumer's chain check (which may scan many
+    candidate deltas per poll) never materializes row payloads."""
+    data = np.load(path, allow_pickle=False)
+    return json.loads(str(data["__meta__"]))
 
 
 def load_global_weights(path: str, mmap: bool = True) -> List[np.ndarray]:

@@ -75,8 +75,13 @@ def _sgd_respecting_placement(p, g):
 def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
                       seed=0, check_train=True, input_max_hotness=None,
                       rtol=1e-5, atol=1e-5, train_rtol=1e-4, train_atol=1e-5,
-                      **dist_kwargs):
-    """specs: list of (vocab, width) or (vocab, width, combiner)."""
+                      store_roundtrip=False, **dist_kwargs):
+    """specs: list of (vocab, width) or (vocab, width, combiner).
+
+    store_roundtrip (ISSUE 6): materialize the params through the
+    versioned table store's publish/consume path (snapshot file ->
+    consumer apply) before running the checks, so every equivalence
+    property also holds for store-backed parameters."""
     rng = np.random.RandomState(seed)
     embeddings = []
     combiners = []
@@ -107,6 +112,15 @@ def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
                                 input_max_hotness=input_max_hotness,
                                 **dist_kwargs)
     params = dist.set_weights(weights)
+    if store_roundtrip:
+        import tempfile
+        from distributed_embeddings_tpu.store import (TableStore,
+                                                      restore_from_published)
+        with tempfile.TemporaryDirectory() as stream_dir:
+            st = TableStore(dist, params)
+            st.commit(params)
+            st.publish(stream_dir)
+            params = restore_from_published(dist, stream_dir).params
 
     ref_w = [jnp.asarray(w) for w in weights]
     ref_outs = ref_apply(ref_w, inputs, table_map, combiners)

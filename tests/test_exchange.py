@@ -180,6 +180,65 @@ def test_exchange_report_bf16_wire_bytes():
     assert rep["id_narrowed_groups"] == list(range(len(rep["groups"])))
 
 
+def test_touched_rows_per_step_schema():
+    """Touched-row accounting (ISSUE 6): every report group carries
+    `touched_rows_per_step` (the dedup'd post-sentinel-mask ids the
+    sparse update writes per step — the number the row-delta size model
+    is built on) and `delta_bytes_per_step` = touched * (8 id bytes +
+    4 * width); batch scales it, the bucket's total rows bound it, and
+    hot-hit lanes subtract (they skip the canonical scatter)."""
+    specs = [(96, 8, "sum"), (50, 8, "sum"), (100, 16, "sum"),
+             (120, 8, "sum")]
+    dist, _ = make_dist(specs, input_max_hotness=[4, 4, 4, 4])
+    rep = dist.exchange_padding_report()
+    for g in rep["groups"]:
+        bucket = dist.plan.tp_buckets[g["bucket"]]
+        assert g["touched_rows_per_step"] == g["true_ids"]  # per-sample
+        assert g["delta_bytes_per_step"] == (
+            g["touched_rows_per_step"] * (8 + 4 * bucket.width))
+    assert rep["touched_rows_per_step"] == sum(
+        g["touched_rows_per_step"] for g in rep["groups"])
+    assert rep["delta_bytes_per_step"] == sum(
+        g["delta_bytes_per_step"] for g in rep["groups"])
+
+    # batch scaling caps at the bucket's total row count (dedup bound)
+    rep_b = dist.exchange_padding_report(batch=10 ** 6)
+    for g in rep_b["groups"]:
+        bucket = dist.plan.tp_buckets[g["bucket"]]
+        cap = dist.world_size * max(bucket.rows_max, 1)
+        assert g["touched_rows_per_step"] == cap
+    assert (rep_b["touched_rows_per_step"]
+            > rep["touched_rows_per_step"])
+
+    # hot-hit lanes are sentinel-masked: they leave the canonical
+    # touched set (the delta still republishes them via the merged
+    # view, but the SPARSE UPDATE's write volume is post-hot)
+    hot_specs = [(500, 8, "sum")] + [(100 + i, 8) for i in range(7)]
+    hot_dist, _ = make_dist(hot_specs, hot_rows=64,
+                            input_max_hotness=[4] + [1] * 7)
+    assert hot_dist._hot_buckets
+    r0 = hot_dist.exchange_padding_report()
+    r1 = hot_dist.exchange_padding_report(hot_hit_rate=0.5)
+    hot_g0 = [g for g in r0["groups"]
+              if g["bucket"] in hot_dist._hot_buckets]
+    hot_g1 = [g for g in r1["groups"]
+              if g["bucket"] in hot_dist._hot_buckets]
+    assert sum(g["touched_rows_per_step"] for g in hot_g1) < sum(
+        g["touched_rows_per_step"] for g in hot_g0)
+    for g in hot_g1:
+        assert g["touched_rows_per_step"] == g["true_ids_post_hot"]
+        # ... but the BYTE model re-adds the republished hot-hit rows
+        # (the delta carries their merged values), so it exceeds the
+        # canonical-write term alone
+        bucket = hot_dist.plan.tp_buckets[g["bucket"]]
+        assert g["delta_bytes_per_step"] == (
+            (g["touched_rows_per_step"]
+             + min(g["hot_hit_ids"], bucket.hot_rows))
+            * (8 + 4 * bucket.width))
+        assert g["delta_bytes_per_step"] > (
+            g["touched_rows_per_step"] * (8 + 4 * bucket.width))
+
+
 def test_one_hot_auto_resolves_basic():
     specs = [(96, 8), (50, 8), (100, 16), (120, 8)]
     dist, _ = make_dist(specs, input_max_hotness=[1, 1, 1, 1])

@@ -49,6 +49,12 @@ def gen_config(seed):
         # covers it (combiner-None buckets keep f32 by the plan gate)
         kw["exchange_wire"] = "bf16"
         kw.update(rtol=4e-2, atol=4e-2, train_rtol=4e-2, train_atol=4e-2)
+    if rng.rand() < 0.35:
+        # store-backed axis (ISSUE 6): params materialize through the
+        # table store's publish/consume path (snapshot file -> consumer
+        # apply — bit-exact by contract), so every equivalence property
+        # in this sweep also runs against store-backed parameters
+        kw["store_roundtrip"] = True
     return specs, table_map, kw
 
 

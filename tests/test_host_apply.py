@@ -92,6 +92,29 @@ def test_matches_jax_host_rule(kind):
             assert float(a) == float(b)
 
 
+def test_set_kind_replaces_rows():
+    """kind='set' (ISSUE 6, the weight-streaming delta apply seam):
+    valid reps get their rows REPLACED by the payload, invalid (padded)
+    slots — which alias row 0 — leave the table untouched."""
+    table, rep, sums, valid = _rows(7)
+    before = table.copy()
+    payload = np.random.RandomState(9).randn(*sums.shape) \
+        .astype(np.float32)
+    sparse_update.host_apply_rows_inplace("set", table, (), rep, payload,
+                                          valid, 0.0)
+    ok = valid > 0.0
+    np.testing.assert_array_equal(table[rep[ok]], payload[ok])
+    untouched = np.ones(len(table), bool)
+    untouched[rep[ok]] = False
+    np.testing.assert_array_equal(table[untouched], before[untouched])
+    # zero-valid call (all slots padded): a pure no-op
+    t2 = before.copy()
+    sparse_update.host_apply_rows_inplace(
+        "set", t2, (), np.zeros_like(rep), payload,
+        np.zeros_like(valid), 0.0)
+    np.testing.assert_array_equal(t2, before)
+
+
 def test_non_f32_rejected():
     table, rep, sums, valid = _rows(2)
     with pytest.raises(TypeError, match="float32-only"):

@@ -350,7 +350,10 @@ def test_latency_histogram_percentiles():
 
 def test_serve_bench_cpu_emits_fields():
     """(d) `bench.py --mode serve` runs on CPU and emits throughput,
-    hit-rate and latency-percentile fields in its one JSON line."""
+    hit-rate and latency-percentile fields in its one JSON line — plus
+    the concurrent-updater arm's weight-streaming schema (ISSUE 6):
+    delta-vs-full bytes, staleness, monotonic versions, and bit-exact
+    publisher/consumer parity after the async delta applies."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)          # single CPU device is enough
@@ -360,7 +363,8 @@ def test_serve_bench_cpu_emits_fields():
     p = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py"), "--mode", "serve",
          "--requests", "12", "--batch", "16", "--capacity", "256",
-         "--alpha", "1.5"],
+         "--alpha", "1.5", "--updater_steps", "6", "--publish_every", "2",
+         "--train_batch", "32"],
         capture_output=True, text=True, timeout=600, env=env, cwd=repo)
     assert p.returncode == 0, p.stderr[-2000:]
     line = [ln for ln in p.stdout.splitlines() if ln.startswith("{")][-1]
@@ -372,3 +376,19 @@ def test_serve_bench_cpu_emits_fields():
               "serve_batch_occupancy", "serve_queue_depth_max"):
         assert k in record, k
     assert record["serve_p50_ms"] > 0
+    # weight-streaming arm schema + contract
+    for k in ("serve_updates_published", "serve_updates_applied",
+              "serve_updates_applied_deltas",
+              "serve_full_table_bytes", "serve_delta_bytes_mean",
+              "serve_delta_full_ratio", "serve_delta_apply_rows_per_sec",
+              "serve_staleness_versions_max", "serve_staleness_s_mean",
+              "serve_version_monotonic", "serve_update_parity_max_dev"):
+        assert k in record, k
+    assert "serve_updater_error" not in record, record
+    # the DELTA count gates the streaming path — the pre-clock snapshot
+    # sync alone must never satisfy this
+    assert record["serve_updates_applied_deltas"] >= 1
+    assert record["serve_version_monotonic"] is True
+    assert record["serve_update_parity_max_dev"] == 0.0
+    # row deltas at zipfian touched-row rates stay far under a full copy
+    assert record["serve_delta_full_ratio"] <= 0.1, record
