@@ -841,6 +841,184 @@ def hotrows_main(argv=None) -> int:
     return 0 if "hotrows_error" not in record else 1
 
 
+# ----------------------------------------------------------------- vocab
+def run_vocab_bench(steps: int = 64, batch: int = 4096, tables: int = 4,
+                    vocab: int = 50_000, slack: int = 8192,
+                    width: int = 32, alpha: float = 1.2,
+                    drift_every: int = 8, drift_frac: float = 0.25,
+                    admit_threshold: int = 2, decay: float = 0.98,
+                    vocab_every: int = 4, optimizer: str = "adagrad",
+                    seed: int = 0) -> dict:
+    """Dynamic-vocabulary benchmark (ISSUE 7): a zipfian RAW-key stream
+    whose key universe ROTATES (every `drift_every` steps a uniformly
+    random `drift_frac` of the rank space re-bases onto fresh raw keys
+    — under the zipf skew that is mostly tail churn with a steady
+    trickle of head turnover, the 'new users arriving, old users
+    churning' drift a production recommender sees) drives a real
+    sparse training loop through a `VocabManager`. Records admission/eviction rates, steady-state
+    occupancy, fallback-hit rate, the host-side translate/maintain cost,
+    and the compile count of the jitted step across the whole run (the
+    recompile-free-growth claim: it must be 1 per batch shape).
+
+    The structural acceptance is drift WITHOUT unbounded growth:
+    `vocab_occupancy_max` stays <= the manager's high watermark while
+    admissions and evictions both keep happening."""
+    from distributed_embeddings_tpu.layers.embedding import Embedding
+    from distributed_embeddings_tpu.layers.dist_model_parallel import (
+        DistributedEmbedding)
+    from distributed_embeddings_tpu.vocab import VocabManager
+
+    rng = np.random.RandomState(seed)
+    specs = [(vocab, width)] * tables
+    emb = DistributedEmbedding(
+        [Embedding(v, w, combiner="sum") for v, w in specs],
+        vocab_slack=slack)
+
+    class _M:
+        def __init__(self):
+            self.embedding = emb
+
+        def loss_fn(self, p, numerical, cats, labels, taps=None,
+                    return_residuals=False):
+            out = emb(p["embedding"], list(cats), taps=taps,
+                      return_residuals=return_residuals)
+            outs, res = out if return_residuals else (out, None)
+            x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs],
+                                axis=1)
+            loss = jnp.mean((jnp.sum(x, axis=1)
+                             - labels.reshape(-1)) ** 2)
+            return (loss, res) if return_residuals else loss
+
+    model = _M()
+    mgr = VocabManager(emb, admit_threshold=admit_threshold, decay=decay)
+    init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.05)
+    params = {"embedding": emb.init(jax.random.PRNGKey(seed))}
+    state = init_fn(params)
+    step = jax.jit(step_fn, donate_argnums=())
+
+    sample = zipf_sampler(vocab, alpha, rng)
+    # rotating raw-key universe: rank r of epoch e maps to a raw key
+    # that changes for the rotated band each drift epoch
+    epoch_of_rank = np.zeros((vocab,), np.int64)
+    n_rot = max(int(vocab * drift_frac), 1)
+
+    def raw_keys(n):
+        ranks = sample(n).astype(np.int64)
+        return (ranks + 10**9 * (1 + epoch_of_rank[ranks])).astype(np.int64)
+
+    occ_max = 0.0
+    translate_s, maintain_s, step_s = [], [], []
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for i in range(steps):
+            if i and drift_every and i % drift_every == 0:
+                band = rng.choice(vocab, size=n_rot, replace=False)
+                epoch_of_rank[band] += 1          # those ranks = NEW keys
+            cats_raw = [raw_keys(batch).reshape(batch, 1)
+                        for _ in range(tables)]
+            # maintain BEFORE translating (fit's ordering): a rebind in
+            # the cycle must be visible to this batch's translation
+            if i and vocab_every and i % vocab_every == 0:
+                t0 = time.perf_counter()
+                p_emb, s_emb = mgr.maintain(params["embedding"],
+                                            state["emb"])
+                params = {**params, "embedding": p_emb}
+                state = {**state, "emb": s_emb}
+                maintain_s.append(time.perf_counter() - t0)
+                occ = mgr.stats()["occupancy"]
+                occ_max = max(occ_max, occ)
+            t0 = time.perf_counter()
+            cats = mgr.translate(cats_raw, observe=True)
+            translate_s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            params, state, loss = step(
+                params, state, jnp.zeros((batch, 1)),
+                [jnp.asarray(c) for c in cats],
+                jnp.zeros((batch,), jnp.float32))
+            jax.block_until_ready(loss)
+            step_s.append(time.perf_counter() - t0)
+    st = mgr.stats()
+    cycles = max(st["maintain_cycles"], 1)
+    rep = emb.exchange_padding_report(vocab=mgr)
+    return {
+        "metric": "vocab_zipf_drift_admission",
+        "vocab_steps": steps,
+        "vocab_batch": batch,
+        "vocab_tables": tables,
+        "vocab_rows": vocab,
+        "vocab_slack": slack,
+        "vocab_alpha": alpha,
+        "vocab_drift_every": drift_every,
+        "vocab_drift_frac": drift_frac,
+        "vocab_admit_threshold": admit_threshold,
+        "vocab_decay": decay,
+        "vocab_admissions": st["admissions"],
+        "vocab_evictions": st["evictions"],
+        "vocab_admission_rate_per_step": round(st["admissions"] / steps, 3),
+        "vocab_eviction_rate_per_step": round(st["evictions"] / steps, 3),
+        "vocab_admissions_per_cycle": round(st["admissions"] / cycles, 3),
+        "vocab_occupancy": st["occupancy"],
+        "vocab_occupancy_max": round(occ_max, 4),
+        "vocab_high_watermark": mgr.high_watermark,
+        "vocab_fallback_hit_rate": st["fallback_hit_rate"],
+        "vocab_bound_rows": st["bound"],
+        "vocab_report_occupancy": rep["occupancy"],
+        "vocab_report_slack_rows": rep["slack_rows"],
+        "vocab_report_evictions_per_step": rep["evictions_per_step"],
+        "vocab_step_compiles": step._cache_size(),
+        "vocab_translate_ms_mean": round(
+            1e3 * float(np.mean(translate_s)), 3),
+        "vocab_maintain_ms_mean": round(
+            1e3 * float(np.mean(maintain_s)), 3) if maintain_s else 0.0,
+        "vocab_step_ms_mean": round(1e3 * float(np.mean(step_s)), 3),
+        "vocab_samples_per_sec": round(
+            batch / float(np.mean(step_s[len(step_s) // 2:]))),
+        "git_sha": _git_sha(),
+    }
+
+
+def vocab_main(argv=None) -> int:
+    """`bench.py --mode vocab` entry point: one JSON line, like main()."""
+    import argparse
+    p = argparse.ArgumentParser(description="dynamic vocabulary benchmark")
+    p.add_argument("--mode", choices=["vocab"], default="vocab")
+    p.add_argument("--steps", type=int, default=64)
+    p.add_argument("--batch", type=int, default=4096)
+    p.add_argument("--tables", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=50_000)
+    p.add_argument("--slack", type=int, default=8192)
+    p.add_argument("--width", type=int, default=32)
+    p.add_argument("--alpha", type=float, default=1.2)
+    p.add_argument("--drift_every", type=int, default=8)
+    p.add_argument("--drift_frac", type=float, default=0.25)
+    p.add_argument("--admit_threshold", type=int, default=2)
+    p.add_argument("--decay", type=float, default=0.98)
+    p.add_argument("--vocab_every", type=int, default=4)
+    p.add_argument("--optimizer", default="adagrad",
+                   choices=["sgd", "adagrad", "adam"])
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        record = run_vocab_bench(
+            steps=args.steps, batch=args.batch, tables=args.tables,
+            vocab=args.vocab, slack=args.slack, width=args.width,
+            alpha=args.alpha, drift_every=args.drift_every,
+            drift_frac=args.drift_frac,
+            admit_threshold=args.admit_threshold, decay=args.decay,
+            vocab_every=args.vocab_every, optimizer=args.optimizer,
+            seed=args.seed)
+    except Exception as e:  # noqa: BLE001 - one JSON line, like main()
+        import traceback
+        traceback.print_exc()
+        record = {"metric": "vocab_zipf_drift_admission",
+                  "vocab_error": str(e)[:300], "git_sha": _git_sha()}
+    print(json.dumps(record))
+    return 0 if "vocab_error" not in record else 1
+
+
 # ------------------------------------------------------------------ wire
 def run_wire_bench(vocab: int = 100_000, width: int = 128, tables: int = 8,
                    batch: int = 8192, hotness: int = 1, world: int = 8,
@@ -1730,6 +1908,8 @@ if __name__ == "__main__":
         sys.exit(hotrows_main(sys.argv[1:]))
     elif _cli_mode() == "wire":
         sys.exit(wire_main(sys.argv[1:]))
+    elif _cli_mode() == "vocab":
+        sys.exit(vocab_main(sys.argv[1:]))
     elif os.environ.get("DET_BENCH_INNER") == "1":
         main()
     else:

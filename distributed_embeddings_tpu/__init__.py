@@ -42,6 +42,8 @@ from distributed_embeddings_tpu.store import (
     DeltaConsumer,
     TableStore,
 )
+from distributed_embeddings_tpu import vocab
+from distributed_embeddings_tpu.vocab import VocabManager
 
 __all__ = [
     "__version__",
@@ -65,4 +67,6 @@ __all__ = [
     "store",
     "TableStore",
     "DeltaConsumer",
+    "vocab",
+    "VocabManager",
 ]

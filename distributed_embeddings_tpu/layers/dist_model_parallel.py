@@ -363,6 +363,15 @@ class DistributedEmbedding:
         Gated off per bucket where the planner knows rounding would be
         user-visible (combiner-None passthrough buckets keep f32); see
         `exchange_padding_report` for the resulting byte accounting.
+      vocab_slack: dynamic-vocabulary growth capacity (ISSUE 7): extra
+        physical rows pre-reserved per table-parallel table beyond its
+        configured input_dim, so a `vocab.VocabManager` can admit new
+        raw keys at runtime by binding them to free rows — no array
+        shape ever changes, so the jitted step never recompiles. None
+        defers to `DET_VOCAB_SLACK` (default 0 = exactly the pre-slack
+        plan). The slack inflates the table's physical shape: `init`,
+        `get_weights`/`set_weights` and checkpoints all see
+        ``input_dim + vocab_slack`` rows for managed tables.
     """
 
     def __init__(self,
@@ -380,7 +389,8 @@ class DistributedEmbedding:
                  use_custom_kernel: bool = True,
                  compute_dtype: Optional[Any] = None,
                  hot_rows: Optional[int] = None,
-                 exchange_wire: Optional[str] = None):
+                 exchange_wire: Optional[str] = None,
+                 vocab_slack: Optional[int] = None):
         if mesh is None and world_size is not None and world_size > 1:
             mesh = create_mesh(jax.devices()[:world_size])
         self.mesh = mesh
@@ -412,7 +422,8 @@ class DistributedEmbedding:
             gpu_embedding_size=gpu_embedding_size,
             input_hotness=input_max_hotness,
             hot_rows=(hot_rows if dp_input else 0),
-            exchange_wire=exchange_wire)
+            exchange_wire=exchange_wire,
+            vocab_slack=vocab_slack)
 
         if self.strategy.table_groups[1]:
             if not all(self.strategy.local_configs):
@@ -796,7 +807,8 @@ class DistributedEmbedding:
         return res
 
     def exchange_padding_report(self, hotness=None,
-                                hot_hit_rate=None, batch: int = 1) -> dict:
+                                hot_hit_rate=None, batch: int = 1,
+                                vocab=None) -> dict:
         """Static accounting of the dp->mp id-exchange volume.
 
         The exchange sends one dense [world, f_max, k] id block per
@@ -856,17 +868,30 @@ class DistributedEmbedding:
         weight-streaming store publishes at (docs/perf_model.md
         "Weight streaming").
 
+        Dynamic vocabulary (ISSUE 7): every group also carries the
+        bucket's capacity accounting — `slack_rows` (growth rows the
+        planner pre-reserved in this bucket, folded into rows_max),
+        `occupancy` (live rows / capacity rows over the bucket's
+        tables: managed tables report their binding's bound count when
+        a `vocab.VocabManager` is passed, 1.0 means every row is live —
+        the static-vocabulary reading), and `evictions_per_step`
+        (measured demotions per maintain cycle from the manager, 0.0
+        without one). Top-level totals aggregate the same three.
+
         Args:
           hotness: per-tp-input hotness override; defaults to the layer's
             input_max_hotness hints (unhinted inputs count as 1).
           hot_hit_rate: hot-shard hit-rate override (see above).
           batch: global batch size for the touched-row/delta-size model
             (default 1 = per-sample accounting, matching the id fields).
+          vocab: optional `vocab.VocabManager` supplying measured
+            occupancy/eviction numbers for managed tables.
         Returns {"groups": [...], "true_ids", "exchanged_ids", "ratio",
         "exchanged_bytes", "true_bytes", "act_bytes", "act_bytes_f32",
         "act_wire_reduction", "wire_dtypes", "id_narrowed_groups",
         "hot_hit_ids", "true_ids_post_hot", "hot_hit_rates",
-        "touched_rows_per_step", "delta_bytes_per_step"}.
+        "touched_rows_per_step", "delta_bytes_per_step", "occupancy",
+        "slack_rows", "evictions_per_step"}.
         """
         tp_inputs = self.strategy.input_groups[1]
         if hotness is None:
@@ -887,6 +912,42 @@ class DistributedEmbedding:
             tr = self._hot_trackers.get(b)
             return tr.hit_rate if tr is not None else 0.0
 
+        def bucket_vocab(b):
+            """(occupancy, slack_rows, evictions_per_step) of bucket b:
+            live rows / capacity rows over the bucket's tables (managed
+            tables read their binding; static tables are fully live)."""
+            bucket = self.plan.tp_buckets[b]
+            tids = sorted({self.strategy.table_groups[1][pl.table_id]
+                           for pl in self.plan.tp_placements
+                           if pl.bucket == b})
+            live = cap = 0
+            ev = 0.0
+            # per-STEP denominator: observing translate() calls (one per
+            # training step in the fit wiring); maintain cycles are the
+            # fallback for managers driven without translation
+            steps = max(getattr(vocab, "observe_steps", 0)
+                        or getattr(vocab, "maintain_cycles", 0), 1) \
+                if vocab is not None else 1
+            for gtid in tids:
+                cfg = self.strategy.global_configs[gtid]
+                rows = int(cfg["input_dim"])
+                cap += rows
+                mv = (vocab.vocabs.get(gtid)
+                      if vocab is not None else None)
+                if mv is not None:
+                    live += 1 + mv.bound    # fallback row is always live
+                    ev += mv.evictions / steps
+                else:
+                    # no manager over this table: its build rows are
+                    # live, but any pre-reserved slack is DEAD capacity
+                    # (nothing can ever bind it) — counting it live
+                    # would report a misleading 1.0 for slack plans run
+                    # without (or outside) a manager
+                    live += rows - int(cfg.get("vocab_slack", 0))
+            return ((live / cap) if cap else 1.0, bucket.slack_rows, ev)
+
+        vocab_by_bucket = {b: bucket_vocab(b)
+                           for b in range(len(self.plan.tp_buckets))}
         key = tuple((int(h), False) for h in hotness)
         groups, _ = self._exchange_groups_for_key(key)
         report, true_tot, ex_tot, hot_tot = [], 0, 0, 0
@@ -934,6 +995,10 @@ class DistributedEmbedding:
                 "exchanged_bytes": ex_bytes,
                 "true_bytes": true_bytes,
                 "weight_bytes_if_weighted": ex_ids * wire_b,
+                "occupancy": round(vocab_by_bucket[g.bucket][0], 4),
+                "slack_rows": vocab_by_bucket[g.bucket][1],
+                "evictions_per_step": round(vocab_by_bucket[g.bucket][2],
+                                            4),
                 "path_taken": self._exchange_path_taken.get(
                     (g.bucket, g.f_max, g.k)),
             }
@@ -982,6 +1047,29 @@ class DistributedEmbedding:
                 "hot_hit_rates": {b: rate_for(b) for b in self._hot_buckets},
                 "touched_rows_per_step": touched_tot,
                 "delta_bytes_per_step": delta_bytes_tot,
+                # capacity accounting (ISSUE 7), each bucket counted ONCE
+                # (a bucket can serve several hotness groups): occupancy
+                # capacity-weighted over buckets, slack/evictions summed
+                "occupancy": round(
+                    sum(vocab_by_bucket[b][0]
+                        * max(self.plan.tp_buckets[b].rows_max, 1)
+                        for b in vocab_by_bucket)
+                    / max(sum(max(self.plan.tp_buckets[b].rows_max, 1)
+                              for b in vocab_by_bucket), 1), 4)
+                if vocab_by_bucket else 1.0,
+                "slack_rows": sum(v[1] for v in vocab_by_bucket.values()),
+                # top-level evictions come from the MANAGER, not a
+                # bucket sum: a column-sliced table spanning several
+                # buckets (unequal slice widths land in different
+                # width-keyed buckets) would otherwise count each
+                # logical eviction once per bucket. Per-group entries
+                # keep the per-bucket view — each bucket genuinely
+                # rewrites its slice of a rebound row.
+                "evictions_per_step": round(
+                    sum(mv.evictions for mv in vocab.vocabs.values())
+                    / max(getattr(vocab, "observe_steps", 0)
+                          or getattr(vocab, "maintain_cycles", 0), 1),
+                    4) if vocab is not None else 0.0,
                 "exchange_paths": dict(self._exchange_path_taken)}
 
     def residual_sort_scope(self, spec):

@@ -177,6 +177,11 @@ class IntegerLookup:
 
     This layer is stateful host-side preprocessing: call it outside jit (like
     a tf.data transform), or via `as_callback()` inside jit.
+
+    Reserved keys: the two most negative int64 values (INT64_MIN and
+    INT64_MIN+1 — the native map's empty/tombstone slot sentinels) are
+    never bound; they translate to OOV (0) on every path, on both
+    backends. No realistic hash or id space reaches them.
     """
 
     def __init__(self, max_tokens: int, use_native: Optional[bool] = None):
@@ -276,23 +281,55 @@ class IntegerLookup:
         the numpy fallback counts per batch."""
         return self._backend.counts()
 
+    def erase(self, keys) -> np.ndarray:
+        """Unbind keys from the vocabulary (ISSUE 7 eviction): each key's
+        index is released and will be REUSED by a later insertion (LIFO),
+        so a bounded table can follow an unbounded, drifting key space.
+        Returns the freed index per key (0 = key was not bound). A later
+        `lookup` of an erased key returns 0 (OOV) again, and its
+        frequency count resets — a future tenant of the index must not
+        inherit it."""
+        arr = np.asarray(keys, dtype=np.int64)
+        return self._backend.erase(arr.reshape(-1)).reshape(arr.shape)
+
+    def free_slots(self) -> np.ndarray:
+        """Erased (reusable) indices in reuse order — together with
+        `get_vocabulary` this is the full binding state eviction-aware
+        checkpoints round-trip."""
+        return np.asarray(self._backend.free_slots(), np.int64)
+
     def get_vocabulary(self):
         """Keys in insertion (lookup-index) order, with -1 in the OOV slot
-        (reference embedding.py:255-281 returns [-1] + keys)."""
-        return [-1] + self._backend.keys_in_index_order()
+        (reference embedding.py:255-281 returns [-1] + keys). Erased
+        indices appear as None holes (their positions must keep later
+        keys index-aligned) until reused."""
+        hole = np.iinfo(np.int64).min
+        return [-1] + [None if k == hole else k
+                       for k in self._backend.keys_in_index_order()]
 
     @property
     def size(self) -> int:
+        """Live vocabulary size including the OOV slot (erases shrink)."""
         return self._backend.size + 1  # + OOV slot
 
 
 class _NumpyIntegerLookup:
-    """Pure-python fallback backend: dict-based, OOV (full table) -> 0."""
+    """Pure-python fallback backend: dict-based, OOV (full table) -> 0.
+    Mirrors the native contract including erase: freed indices reused
+    LIFO before new ones are minted past the high-water mark, and the
+    two RESERVED key values (the native map's slot sentinels,
+    INT64_MIN and INT64_MIN+1) map to OOV without ever being stored —
+    a dict would happily hold them, but the backends must agree."""
+
+    _HOLE = np.iinfo(np.int64).min
+    _RESERVED = (np.iinfo(np.int64).min, np.iinfo(np.int64).min + 1)
 
     def __init__(self, capacity: int):
         self.capacity = capacity
         self._map = {}
         self._counts = np.zeros((capacity,), np.int64)
+        self._free = []           # erased indices, reuse order (LIFO)
+        self._high = 0            # highest index ever assigned
 
     @property
     def size(self) -> int:
@@ -303,15 +340,35 @@ class _NumpyIntegerLookup:
         m = self._map
         cap = self.capacity - 1  # index 0 reserved for OOV
         for i, k in enumerate(keys.tolist()):
+            if k in self._RESERVED:
+                out[i] = 0
+                continue
             idx = m.get(k)
             if idx is None:
                 if len(m) < cap:
-                    idx = len(m) + 1
+                    if self._free:
+                        idx = self._free.pop()
+                    else:
+                        self._high += 1
+                        idx = self._high
                     m[k] = idx
                 else:
                     idx = 0
             out[i] = idx
         return out
+
+    def erase(self, keys: np.ndarray) -> np.ndarray:
+        out = np.zeros(keys.shape, dtype=np.int64)
+        for i, k in enumerate(keys.tolist()):
+            idx = self._map.pop(k, None)
+            if idx is not None:
+                out[i] = idx
+                self._free.append(idx)
+                self._counts[idx] = 0
+        return out
+
+    def free_slots(self) -> np.ndarray:
+        return np.asarray(self._free, np.int64)
 
     def add_counts(self, indices: np.ndarray) -> None:
         """Per-OCCURRENCE frequency accounting (the class-level caller
@@ -327,7 +384,10 @@ class _NumpyIntegerLookup:
         return out
 
     def keys_in_index_order(self):
-        return [k for k, _ in sorted(self._map.items(), key=lambda kv: kv[1])]
+        out = [self._HOLE] * self._high
+        for k, idx in self._map.items():
+            out[idx - 1] = k
+        return out
 
     def counts(self) -> np.ndarray:
         return self._counts.copy()

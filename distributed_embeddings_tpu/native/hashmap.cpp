@@ -23,19 +23,33 @@
 
 namespace {
 
-constexpr int64_t kEmpty = INT64_MIN;  // sentinel for empty slot
+constexpr int64_t kEmpty = INT64_MIN;          // sentinel for empty slot
+constexpr int64_t kTombstone = INT64_MIN + 1;  // erased slot (probe through)
+
+// The two slot sentinels are RESERVED key values: a user key equal to
+// either would corrupt probe chains (its occupied slot would read as
+// empty/erased and be silently overwritten). Both map to OOV instead —
+// the same graceful answer a full table gives (the numpy backend
+// mirrors this; the Python layer documents the reservation).
+inline bool reserved_key(int64_t key) {
+  return key == kEmpty || key == kTombstone;
+}
 
 struct IntegerLookupMap {
   int64_t capacity;    // max distinct keys + 1 (index 0 = OOV)
   int64_t num_slots;   // power of two >= 1.5 * capacity
   int64_t mask;
-  int64_t size;        // number of inserted keys
+  int64_t size;        // number of LIVE keys (erases decrement)
+  int64_t tombstones;  // erased slots awaiting reuse/rehash
   std::vector<int64_t> slot_keys;
   std::vector<int64_t> slot_vals;      // index assigned to the key
   std::vector<int64_t> keys_by_index;  // reverse map: index-1 -> key
+                                       // (kEmpty hole for erased indices)
   std::vector<int64_t> counts;         // per-index frequency (index 0 = OOV)
+  std::vector<int64_t> free_idx;       // erased indices, reused LIFO
 
-  explicit IntegerLookupMap(int64_t cap) : capacity(cap), size(0) {
+  explicit IntegerLookupMap(int64_t cap)
+      : capacity(cap), size(0), tombstones(0) {
     int64_t want = static_cast<int64_t>(cap * 3 / 2) + 2;
     num_slots = 16;
     while (num_slots < want) num_slots <<= 1;
@@ -55,29 +69,100 @@ struct IntegerLookupMap {
   }
 
   inline int64_t find(int64_t key) const {
+    if (reserved_key(key)) return 0;  // -> OOV
     uint64_t h = hash(key) & mask;
     while (true) {
       int64_t k = slot_keys[h];
       if (k == key) return slot_vals[h];
-      if (k == kEmpty) return -1;
+      if (k == kEmpty) return -1;  // tombstones probe through
       h = (h + 1) & mask;
     }
   }
 
   inline int64_t find_or_insert(int64_t key) {
+    if (reserved_key(key)) return 0;  // -> OOV, never stored
     uint64_t h = hash(key) & mask;
+    int64_t first_tomb = -1;
     while (true) {
       int64_t k = slot_keys[h];
       if (k == key) return slot_vals[h];
-      if (k == kEmpty) {
+      if (k == kTombstone && first_tomb < 0) {
+        first_tomb = static_cast<int64_t>(h);
+      } else if (k == kEmpty) {
         if (size >= capacity - 1) return 0;  // table full -> OOV
-        int64_t idx = ++size;                // indices start at 1
+        // indices: reuse an erased one (eviction freed its row slot)
+        // before minting past the high-water mark
+        int64_t idx;
+        if (!free_idx.empty()) {
+          idx = free_idx.back();
+          free_idx.pop_back();
+          keys_by_index[idx - 1] = key;
+        } else {
+          idx = static_cast<int64_t>(keys_by_index.size()) + 1;
+          keys_by_index.push_back(key);
+        }
+        ++size;
+        if (first_tomb >= 0) {
+          h = static_cast<uint64_t>(first_tomb);
+          --tombstones;
+        }
         slot_keys[h] = key;
         slot_vals[h] = idx;
-        keys_by_index.push_back(key);
+        // the probe loops terminate only on a kEmpty slot, so SOME
+        // kEmpty slots must always survive: inserts that land on a
+        // kEmpty slot (not a reused tombstone) consume one, and must
+        // uphold the same occupancy bound erase() does — without this,
+        // churn whose inserts keep missing the tombstones can fill the
+        // last empty slot and the next absent-key lookup spins forever
+        if (first_tomb < 0 && tombstones + size > (num_slots * 7) / 8)
+          rehash();
         return idx;
       }
       h = (h + 1) & mask;
+    }
+  }
+
+  // Erase a key: its index is freed for reuse, its slot becomes a
+  // tombstone (probe chains through it stay intact), its frequency
+  // count resets (a future key bound to this index must not inherit
+  // it). Returns the freed index, 0 if the key was not present.
+  inline int64_t erase(int64_t key) {
+    if (reserved_key(key)) return 0;
+    uint64_t h = hash(key) & mask;
+    while (true) {
+      int64_t k = slot_keys[h];
+      if (k == key) {
+        int64_t idx = slot_vals[h];
+        slot_keys[h] = kTombstone;
+        slot_vals[h] = 0;
+        ++tombstones;
+        keys_by_index[idx - 1] = kEmpty;
+        counts[idx] = 0;
+        free_idx.push_back(idx);
+        --size;
+        // erase-heavy churn can fill every kEmpty slot with tombstones,
+        // degrading probes toward O(num_slots); rebuild from the live
+        // reverse map well before that (live keys are bounded by
+        // capacity <= 2/3 num_slots, so post-rehash load stays sane)
+        if (tombstones + size > (num_slots * 7) / 8) rehash();
+        return idx;
+      }
+      if (k == kEmpty) return 0;
+      h = (h + 1) & mask;
+    }
+  }
+
+  void rehash() {
+    std::fill(slot_keys.begin(), slot_keys.end(), kEmpty);
+    std::fill(slot_vals.begin(), slot_vals.end(), 0);
+    tombstones = 0;
+    for (size_t i = 0; i < keys_by_index.size(); ++i) {
+      int64_t key = keys_by_index[i];
+      if (key == kEmpty) continue;
+      uint64_t h = hash(key) & mask;
+      while (slot_keys[h] != kEmpty) h = (h + 1) & mask;
+      slot_keys[h] = key;
+      slot_vals[h] = static_cast<int64_t>(i) + 1;
     }
   }
 };
@@ -275,12 +360,42 @@ void il_lookup(void* handle, const int64_t* keys, int64_t n, int64_t* out) {
   });
 }
 
-// keys_out must have room for il_size() entries (index order, 1-based
-// indices: keys_out[i] is the key mapped to index i+1).
+// keys_out must have room for il_high_water() entries (index order,
+// 1-based indices: keys_out[i] is the key mapped to index i+1; erased
+// indices export INT64_MIN holes). high_water == size when no key was
+// ever erased, so pre-erase callers see the original contract.
 void il_export_keys(void* handle, int64_t* keys_out) {
   auto* m = static_cast<IntegerLookupMap*>(handle);
   std::memcpy(keys_out, m->keys_by_index.data(),
               sizeof(int64_t) * m->keys_by_index.size());
+}
+
+// Highest index ever assigned (= export_keys entry count).
+int64_t il_high_water(void* handle) {
+  return static_cast<int64_t>(
+      static_cast<IntegerLookupMap*>(handle)->keys_by_index.size());
+}
+
+// Erase keys (ISSUE 7 eviction): out[i] = the freed index, 0 if the key
+// was not bound. Sequential — erase batches are eviction-sized (small),
+// and the tombstone/rehash writes need no probe parallelism.
+void il_erase(void* handle, const int64_t* keys, int64_t n, int64_t* out) {
+  auto* m = static_cast<IntegerLookupMap*>(handle);
+  for (int64_t i = 0; i < n; ++i) out[i] = m->erase(keys[i]);
+}
+
+// Number of freed (reusable) indices.
+int64_t il_free_count(void* handle) {
+  return static_cast<int64_t>(
+      static_cast<IntegerLookupMap*>(handle)->free_idx.size());
+}
+
+// free_out must have room for il_free_count() entries; exported in
+// reuse order (the LAST entry is the next index lookup_or_insert mints).
+void il_export_free(void* handle, int64_t* free_out) {
+  auto* m = static_cast<IntegerLookupMap*>(handle);
+  std::memcpy(free_out, m->free_idx.data(),
+              sizeof(int64_t) * m->free_idx.size());
 }
 
 // counts_out must have room for capacity entries (index 0 = OOV count).

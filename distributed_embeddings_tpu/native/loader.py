@@ -55,6 +55,15 @@ def load():
         lib.il_lookup.argtypes = [p, ctypes.c_void_p, i64, ctypes.c_void_p]
         lib.il_export_keys.argtypes = [p, ctypes.c_void_p]
         lib.il_export_counts.argtypes = [p, ctypes.c_void_p]
+        # erase/free-slot surface (ISSUE 7): a prebuilt .so from before
+        # the erasable map may lack these — wrappers hasattr-guard
+        if hasattr(lib, "il_erase"):
+            lib.il_erase.argtypes = [p, ctypes.c_void_p, i64, ctypes.c_void_p]
+            lib.il_high_water.restype = i64
+            lib.il_high_water.argtypes = [p]
+            lib.il_free_count.restype = i64
+            lib.il_free_count.argtypes = [p]
+            lib.il_export_free.argtypes = [p, ctypes.c_void_p]
 
         lib.pf_create.restype = p
         lib.pf_create.argtypes = [ctypes.POINTER(ctypes.c_char_p), i64, i64]

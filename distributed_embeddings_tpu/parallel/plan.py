@@ -80,6 +80,12 @@ class TPBucket:
     # bit for bit.
     wire_dtype: str = "f32"
     id_wire_dtype: str = "int32"
+    # dynamic-vocabulary slack (ISSUE 7): pre-reserved growth rows
+    # folded into this bucket's rows_max (max over ranks of the summed
+    # per-table vocab_slack placed on that rank). Informational — the
+    # slack rows are physically indistinguishable from build rows; the
+    # vocab manager owns which are bound. 0 = statically-planned bucket.
+    slack_rows: int = 0
     # NOTE: runtime [world, f_max] sel/offset constants live on
     # _ExchangeGroup (dist_model_parallel._exchange_groups), grouped by
     # hotness — the bucket itself carries only placement structure.
@@ -199,6 +205,8 @@ def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
     col_cursor: Dict[int, int] = {}
     # per (rank, local_table_pos) -> (bucket_idx, row_offset)
     local_pos_info: List[List[Tuple[int, int]]] = []
+    # per (bucket, rank): summed vocab_slack of the tables placed there
+    slack_per: Dict[Tuple[int, int], int] = {}
 
     for rank in range(world):
         table_ids = strategy.table_ids[rank] if strategy.table_ids else []
@@ -219,6 +227,8 @@ def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
             bucket = buckets[b]
             row_offset = bucket.rows[rank]
             bucket.rows[rank] += cfg["input_dim"]
+            slack_per[(b, rank)] = (slack_per.get((b, rank), 0)
+                                    + int(cfg.get("vocab_slack", 0)))
             bucket.init_segments[rank].append(
                 (table_id, row_offset, cfg["input_dim"],
                  cfg.get("embeddings_initializer", "uniform"),
@@ -233,8 +243,10 @@ def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
             rank_info.append((b, row_offset))
         local_pos_info.append(rank_info)
 
-    for bucket in buckets:
+    for b, bucket in enumerate(buckets):
         bucket.rows_max = max(bucket.rows) if bucket.rows else 0
+        bucket.slack_rows = max((slack_per.get((b, r), 0)
+                                 for r in range(world)), default=0)
 
     # ---------------- input slots -------------------------------------------
     n_tp_inputs = len(strategy.input_groups[1]) if strategy.input_groups else 0

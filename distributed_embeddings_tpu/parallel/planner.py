@@ -31,6 +31,22 @@ from distributed_embeddings_tpu.utils.initializers import ConcatInitializer
 Config = Dict[str, Any]
 
 
+def default_vocab_slack() -> int:
+    """The `DET_VOCAB_SLACK` environment default for dynamic-vocabulary
+    slack (ISSUE 7): extra physical rows pre-reserved per model-parallel
+    table beyond its configured input_dim. Slack rows are dead weight to
+    a static-vocab model but are what lets a `vocab.VocabManager` ADMIT
+    new keys at runtime without changing any array shape (and therefore
+    without recompiling the jitted step): admission binds a key to a
+    pre-allocated free row, eviction frees one. 0 (the default) reserves
+    nothing — plans are bit-identical to pre-slack plans; an explicit
+    ``vocab_slack=`` argument always wins."""
+    try:
+        return max(0, int(os.environ.get("DET_VOCAB_SLACK", "0")))
+    except ValueError:
+        return 0
+
+
 def default_hot_rows() -> int:
     """The `DET_HOT_ROWS` environment default for hot-row replication
     (rows per model-parallel bucket whose top-H hottest rows are
@@ -72,7 +88,8 @@ class DistEmbeddingStrategy:
                  gpu_embedding_size: Optional[int] = None,
                  input_hotness: Optional[Sequence[Optional[int]]] = None,
                  hot_rows: Optional[int] = None,
-                 exchange_wire: Optional[str] = None):
+                 exchange_wire: Optional[str] = None,
+                 vocab_slack: Optional[int] = None):
         if strategy not in ("auto", "basic", "memory_balanced",
                             "memory_optimized", "comm_balanced"):
             raise ValueError(f"Unsupported shard strategy {strategy}")
@@ -130,6 +147,31 @@ class DistEmbeddingStrategy:
                               else [None] * len(self.input_table_map))
 
         self.table_groups = self.init_table_groups(self.global_configs)
+        # dynamic-vocabulary slack (ISSUE 7): inflate every table-parallel
+        # (group 1) table by `vocab_slack` pre-reserved rows AFTER the
+        # dp/col/row grouping (so grouping thresholds keep their configured
+        # meaning) and BEFORE slicing/fusion/lowering (so every downstream
+        # structure — column slices, concat fusion, bucket rows_max, init
+        # segments, weight placements, id-wire proofs — sees the physical
+        # capacity). `vocab_base_rows` keeps the configured vocab so the
+        # vocab manager knows where the build rows end. dp tables
+        # (replicated, dense-trained) and row-sliced tables are not
+        # managed and keep their exact configured shapes.
+        # NOTE: slack is PHYSICAL rows, so it counts toward the
+        # gpu_embedding_size offload budget like any other row — a big
+        # slack can push a table over the budget into host offload,
+        # where the vocab manager refuses to manage it (its slack then
+        # sits unusable in host RAM and the padding report counts it as
+        # dead capacity). Budget slack per table when offload budgets
+        # are in play.
+        self.vocab_slack = (default_vocab_slack() if vocab_slack is None
+                            else max(0, int(vocab_slack)))
+        if self.vocab_slack:
+            for i in self.table_groups[1]:
+                cfg = self.global_configs[i]
+                cfg["vocab_base_rows"] = cfg["input_dim"]
+                cfg["vocab_slack"] = self.vocab_slack
+                cfg["input_dim"] += self.vocab_slack
         (self.input_groups, self.map_groups,
          self.rev_group_ids) = self.init_input_and_map_groups(
             self.table_groups, self.input_table_map)

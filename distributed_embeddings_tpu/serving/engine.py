@@ -81,10 +81,19 @@ class InferenceEngine:
       donate_batch: donate the staged request buffers to the compiled
         forward (saves an HBM copy per request; leave False where the
         caller reuses its input arrays).
+      vocab_manager: optional `vocab.VocabManager` over the same plan
+        (ISSUE 7): `predict` then takes RAW keys for managed tables and
+        translates them to physical rows host-side, query-only (unknown
+        keys serve the fallback row — serving never admits).
+        `poll_updates` keeps the binding current by loading the
+        publisher's ``vocab_v{version}.npz`` sidecars alongside the row
+        deltas, so rebinds arrive through the same publication path as
+        the row payloads they describe.
     """
 
     def __init__(self, model, params, *, cache_capacity=0,
-                 promote_threshold: int = 2, donate_batch: bool = False):
+                 promote_threshold: int = 2, donate_batch: bool = False,
+                 vocab_manager=None):
         if isinstance(model, DistributedEmbedding):
             self._model = None
             self.embedding = model
@@ -105,6 +114,13 @@ class InferenceEngine:
         # the row state
         self.store = TableStore(self.embedding, self._emb_params(params))
         self._consumers: Dict[str, DeltaConsumer] = {}
+        if vocab_manager is not None and vocab_manager.emb is not \
+                self.embedding:
+            raise ValueError(
+                "vocab_manager was built over a different layer; the "
+                "binding's physical rows are plan-specific")
+        self.vocab = vocab_manager
+        self._vocab_loaded_path = None
 
         emb = self.embedding
         self.caches: Dict[int, HotRowCache] = {}
@@ -269,6 +285,10 @@ class InferenceEngine:
         else:
             numerical, cats = batch
             cats = list(cats)
+        if self.vocab is not None:
+            # raw keys -> physical rows, query-only (misses serve the
+            # fallback row; serving traffic never admits or counts)
+            cats = self.vocab.translate(cats)
         prepped = self._normalize(cats)
         b = prepped[0].ids.shape[0]
         target = self._target_batch(b)
@@ -382,6 +402,19 @@ class InferenceEngine:
         infos = consumer.poll()
         for info in infos:
             self._absorb_apply(info)
+        if self.vocab is not None:
+            # rebinds ride the same publication: load the newest binding
+            # sidecar at-or-below the consumed version. NOT gated on new
+            # row files — the publisher writes the sidecar before the
+            # stream file, but a consumer that raced an earlier publish
+            # (or was started against a partially-synced directory) must
+            # still pick the matching binding up on its NEXT poll, not
+            # only when more rows happen to arrive.
+            from distributed_embeddings_tpu.vocab import latest_vocab_state
+            path = latest_vocab_state(publish_dir, upto=self.store.version)
+            if path is not None and path != self._vocab_loaded_path:
+                self.vocab.load_state(path)
+                self._vocab_loaded_path = path
         return infos
 
     def update_stats(self, publish_dir: str) -> dict:

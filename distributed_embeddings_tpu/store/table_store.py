@@ -71,6 +71,7 @@ from distributed_embeddings_tpu.ops import sparse_update as sparse_update_ops
 from distributed_embeddings_tpu.utils import checkpoint as ckpt_lib
 
 __all__ = ["DeltaChainError", "DeltaConsumer", "TableStore",
+           "padded_gather_rows", "padded_scatter_rows",
            "restore_from_published", "scan_published"]
 
 
@@ -95,6 +96,38 @@ def _gather_rows(stack, w_idx, r_idx):
 
 def _next_pow2(n: int) -> int:
     return 1 << max(int(max(n, 1) - 1).bit_length(), 0)
+
+
+def padded_gather_rows(arr, w_idx: np.ndarray,
+                       r_idx: np.ndarray) -> np.ndarray:
+    """Rows of a stacked [world, rows, w] param at (w_idx, r_idx), via
+    the cached jitted gather over pow2-padded (clipped) indices — the
+    ONE padded-index preparation both the store and the vocab manager
+    batch row reads through (the per-shape retrace count stays bounded
+    across both subsystems)."""
+    n = len(w_idx)
+    m = _next_pow2(n)
+    wp = np.zeros((m,), np.int64)
+    rp = np.zeros((m,), np.int64)
+    wp[:n] = np.clip(w_idx, 0, arr.shape[0] - 1)
+    rp[:n] = np.clip(r_idx, 0, arr.shape[1] - 1)
+    return np.asarray(_gather_rows(arr, jnp.asarray(wp),
+                                   jnp.asarray(rp)))[:n]
+
+
+def padded_scatter_rows(arr, w_idx: np.ndarray, r_idx: np.ndarray,
+                        rows: np.ndarray):
+    """Set rows of a stacked param at (w_idx, r_idx) via the cached
+    jitted scatter; pow2-pad lanes carry an out-of-range world index
+    and drop. Shared by delta apply and vocab admission writes."""
+    n = len(w_idx)
+    m = _next_pow2(n)
+    wp = np.full((m,), arr.shape[0], np.int64)     # OOB pad -> dropped
+    rp = np.zeros((m,), np.int64)
+    vp = np.zeros((m,) + tuple(rows.shape[1:]), np.float32)
+    wp[:n], rp[:n], vp[:n] = w_idx, r_idx, rows
+    return _scatter_rows(arr, jnp.asarray(wp), jnp.asarray(rp),
+                         jnp.asarray(vp))
 
 
 def _np_rows_from_shards(arr, w_idx: np.ndarray,
@@ -334,14 +367,7 @@ class TableStore:
         if self.emb._bucket_memory_kind(b):
             out = _np_rows_from_shards(arr, w_idx, r_idx)
         else:
-            n = len(keys)
-            m = _next_pow2(n)
-            wp = np.zeros((m,), np.int64)
-            rp = np.zeros((m,), np.int64)
-            wp[:n] = np.clip(w_idx, 0, arr.shape[0] - 1)
-            rp[:n] = np.clip(r_idx, 0, rows_max - 1)
-            out = np.asarray(_gather_rows(arr, jnp.asarray(wp),
-                                          jnp.asarray(rp)))[:n]
+            out = padded_gather_rows(arr, w_idx, r_idx)
         overlay = self.emb.hot_resident_rows(self._params).get(b)
         if overlay is not None:
             okeys, orows = overlay                 # sorted by construction
@@ -361,14 +387,7 @@ class TableStore:
         w_idx = np.searchsorted(base, keys, side="right") - 1
         r_idx = keys - base[w_idx]
         arr = self._params["row"][t]
-        n = len(keys)
-        m = _next_pow2(n)
-        wp = np.zeros((m,), np.int64)
-        rp = np.zeros((m,), np.int64)
-        wp[:n] = np.clip(w_idx, 0, arr.shape[0] - 1)
-        rp[:n] = np.clip(r_idx, 0, max(rt.rows_max, 1) - 1)
-        return np.asarray(_gather_rows(arr, jnp.asarray(wp),
-                                       jnp.asarray(rp)))[:n]
+        return padded_gather_rows(arr, w_idx, r_idx)
 
     def get_weights(self) -> List[np.ndarray]:
         """Portable merged per-table weights at the current version
@@ -485,14 +504,7 @@ class TableStore:
         if self.emb._bucket_memory_kind(b):
             return _host_set_rows(arr, w_idx, r_idx,
                                   np.asarray(rows, np.float32))
-        n = len(keys)
-        m = _next_pow2(n)
-        wp = np.full((m,), arr.shape[0], np.int64)     # OOB pad -> dropped
-        rp = np.zeros((m,), np.int64)
-        vp = np.zeros((m, rows.shape[1]), np.float32)
-        wp[:n], rp[:n], vp[:n] = w_idx, r_idx, rows
-        return _scatter_rows(arr, jnp.asarray(wp), jnp.asarray(rp),
-                             jnp.asarray(vp))
+        return padded_scatter_rows(arr, w_idx, r_idx, rows)
 
     def _apply_row_rows(self, t: int, keys: np.ndarray, rows: np.ndarray):
         rt = self.emb.plan.row_tables[t]
@@ -500,14 +512,7 @@ class TableStore:
         arr = self._params["row"][t]
         w_idx = np.searchsorted(base, keys, side="right") - 1
         r_idx = keys - base[w_idx]
-        n = len(keys)
-        m = _next_pow2(n)
-        wp = np.full((m,), arr.shape[0], np.int64)
-        rp = np.zeros((m,), np.int64)
-        vp = np.zeros((m, rows.shape[1]), np.float32)
-        wp[:n], rp[:n], vp[:n] = w_idx, r_idx, rows
-        return _scatter_rows(arr, jnp.asarray(wp), jnp.asarray(rp),
-                             jnp.asarray(vp))
+        return padded_scatter_rows(arr, w_idx, r_idx, rows)
 
     def apply_published(self, path: str) -> dict:
         """Apply one stream file (delta or snapshot) in place.

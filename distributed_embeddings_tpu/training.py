@@ -345,7 +345,8 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         eval_steps: int = 16, log_every: int = 100, log_fn=print,
         stage=None, sync_every=None, preprocess=None, pipelined: bool = True,
         pipeline_depth: int = 2, hot_sync_every: int = 0,
-        store=None, publish_every: int = 0, publish_dir=None):
+        store=None, publish_every: int = 0, publish_dir=None,
+        vocab=None, vocab_every: int = 16):
     """Minimal training-loop driver — the role the reference fills with
     Keras `model.fit` + `DistributedOptimizer` + callbacks
     (reference dist_model_parallel.py:1270-1326, synthetic main.py:104-114).
@@ -402,6 +403,21 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         into `publish_dir` for `InferenceEngine.poll_updates` replicas.
         Leftover steps publish once more at the end. Sparse path only.
         History gains a 'published' list of publish infos.
+      vocab / vocab_every: dynamic vocabulary (ISSUE 7, sparse path
+        only): pass a `vocab.VocabManager` over `model.embedding` and
+        the loop treats every batch's categorical inputs as RAW keys —
+        each step translates them to physical rows host-side (unknown
+        keys ride the fallback row) and feeds the admission tracker;
+        every `vocab_every` steps the manager runs one
+        admission/eviction cycle against the live params/opt-state
+        (`maintain` — shapes never change, so the jitted step never
+        recompiles). `vocab_every=0` disables maintenance entirely
+        (translate/observe only — the 0-disables idiom of
+        publish_every/hot_sync_every). Composes with publishing:
+        rebound rows merge into the next delta's key set and the
+        binding state is published as a ``vocab_v{version}.npz``
+        sidecar consumers (`InferenceEngine.poll_updates`) load
+        alongside the rows. History gains 'vocab_stats'.
       hot_sync_every: hot-row replication cadence (layers built with
         `hot_rows=`, sparse path only): every N steps the loop runs
         `sync_hot_rows(admit=True)` — write hot rows back to the
@@ -486,17 +502,63 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
     publishing = bool(sparse and store is not None and publish_every)
     if publishing and publish_dir is None:
         raise ValueError("publish_every requires publish_dir")
+    if vocab is not None and not sparse:
+        raise ValueError("vocab management requires the sparse path "
+                         "(sparse=True)")
+    if vocab is not None and vocab.emb is not getattr(model, "embedding",
+                                                      None):
+        # same guard InferenceEngine applies: the manager's flat row
+        # keys are plan-specific — maintaining another layer's params
+        # with them would scatter into wrong rows silently
+        raise ValueError(
+            "vocab manager was built over a different layer than "
+            "model.embedding; binding rows are plan-specific")
     steps_since_publish = 0
 
     def publish_now():
         drain()                     # params are about to be read host-side
-        store.commit(params["embedding"], opt_state["emb"])
+        store.commit(params["embedding"], opt_state["emb"],
+                     touched=(vocab.drain_touched()
+                              if vocab is not None else None))
+        if vocab is not None:
+            # binding sidecar for the version about to publish — written
+            # BEFORE the stream file, so any consumer that can see the
+            # rows can also see the matching key->row map (the reverse
+            # order would open a window where a poll applies version V's
+            # rows but only finds the V-1 binding)
+            from distributed_embeddings_tpu.vocab import vocab_state_path
+            import os as _os
+            _os.makedirs(publish_dir, exist_ok=True)
+            # full=False: the publish sidecar is the serving-grade
+            # binding (keys + free list), NOT the trainer's counters
+            # and stash — those are checkpoint state and would make
+            # every sidecar table-sized under sustained drift
+            vocab.save_state(vocab_state_path(publish_dir, store.version),
+                             full=False)
         history.setdefault("published", []).append(store.publish(publish_dir))
 
     try:
         for step in range(steps):
             batch = get_batch(step) if get_batch else next(it)
             numerical, cats, labels = batch
+            if vocab is not None:
+                # maintain BEFORE translating this batch: a maintain
+                # cycle can evict key K and immediately rebind K's freed
+                # row to a fresh key — a batch translated before the
+                # cycle would still carry K -> row and land K's gradient
+                # on the new tenant's zero-initialized row. Maintaining
+                # first means every translation this step sees the
+                # post-cycle binding.
+                if vocab_every and step and step % vocab_every == 0:
+                    p_emb, s_emb = vocab.maintain(params["embedding"],
+                                                  opt_state["emb"])
+                    params = {**params, "embedding": p_emb}
+                    opt_state = {**opt_state, "emb": s_emb}
+                # raw keys -> physical rows (host-side; admission
+                # counters fed from the same stream), BEFORE the store's
+                # touched-row observation — the delta key space is
+                # physical rows
+                cats = vocab.translate(list(cats), observe=True)
             if publishing:
                 # EVERY step: the delta's key set must cover every row
                 # the update touches (a sampled feed would silently
@@ -552,8 +614,22 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         params = {**params, "embedding": p_emb}
         opt_state = {**opt_state, "emb": s_emb}
         history["hot_stats"] = hot_emb.hot_stats()
-    if publishing and steps_since_publish:
-        publish_now()               # leftover tail steps reach replicas too
+    if vocab is not None:
+        if vocab_every:
+            # tail cycle: keys that crossed the threshold after the last
+            # scheduled maintain still admit before the run hands back
+            # (vocab_every=0 = maintenance off: translate/observe only,
+            # matching publish_every/hot_sync_every's 0-disables idiom)
+            p_emb, s_emb = vocab.maintain(params["embedding"],
+                                          opt_state["emb"])
+            params = {**params, "embedding": p_emb}
+            opt_state = {**opt_state, "emb": s_emb}
+        history["vocab_stats"] = vocab.stats()
+    if publishing and (steps_since_publish
+                       or (vocab is not None and vocab.pending_publication)):
+        # leftover tail steps — and any rows the tail vocab cycle just
+        # rebound — reach replicas too
+        publish_now()
     return params, opt_state, history
 
 

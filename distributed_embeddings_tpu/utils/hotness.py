@@ -38,20 +38,56 @@ class HotnessTracker:
       max_tracked: bound on the counter dict; beyond it, counters prune
         back to the hottest max_tracked/2 keys (plus residents). Default
         max(64 * capacity, 4096).
+      decay: optional exponential aging factor in (0, 1]: each observing
+        call ages every tracked count by `decay`, so a long-running
+        stream's counts estimate recent frequency rather than all-time
+        totals (ISSUE 7: streaming admission must follow key-universe
+        drift — a key hot an hour ago must eventually lose to a key hot
+        now). The steady-state count of a key seen n times per
+        observation window converges to n / (1 - decay), so
+        promote_threshold keeps its meaning as "sustained recent rate",
+        and counts that age below `DECAY_EPSILON` are dropped (the
+        aged-out analogue of `_prune_counts`, keeping the dict bounded
+        by activity, not history). None (default) keeps the original
+        integer all-time counters — bit-identical policy to every
+        pre-decay caller.
+
+        Implementation is LAZY: aging never sweeps the dict per batch
+        (that would be O(tracked) Python work on every training step —
+        unaffordable at production key rates). Counts are stored in
+        inflated units (`stored = true * decay**-tick`); an observation
+        just bumps the global tick and adds at the current inflation,
+        so a single stored value ages implicitly as the tick advances.
+        The dict is swept only every `DECAY_SWEEP_EVERY` ticks (aged-out
+        eviction, amortized), and stored values renormalize before the
+        inflation factor can overflow a double.
     """
 
+    DECAY_EPSILON = 0.5       # aged counts below this stop being tracked
+    DECAY_SWEEP_EVERY = 64    # aged-out eviction cadence (amortized)
+    _SCALE_RENORM = 1e100     # renormalize stored units before overflow
+
     def __init__(self, capacity: int, promote_threshold: int = 2,
-                 max_tracked: Optional[int] = None):
+                 max_tracked: Optional[int] = None,
+                 decay: Optional[float] = None):
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
         if promote_threshold < 1:
             raise ValueError("promote_threshold must be >= 1")
+        if decay is not None and not 0.0 < decay <= 1.0:
+            raise ValueError(f"decay must be in (0, 1], got {decay}")
         self.capacity = int(capacity)
         self.promote_threshold = int(promote_threshold)
+        self.decay = None if decay is None or decay == 1.0 else float(decay)
         self.max_tracked = int(max_tracked or max(64 * capacity, 4096))
         self._index: Dict[int, int] = {}          # row key -> slot
         self.slot_keys = np.full((self.capacity,), -1, np.int64)
-        self._counts: Dict[int, int] = {}         # row key -> access count
+        # row key -> access count. With decay, values are in INFLATED
+        # units: true_count = stored / _scale, where _scale grows by
+        # 1/decay per observing call (lazy aging — see class docstring)
+        self._counts: Dict[int, float] = {}
+        self._scale = 1.0
+        self._ticks_since_sweep = 0
         self._pending: set = set()                # threshold-crossed keys
         # stats (valid lanes only — callers mask padding before observing)
         self.hits = 0
@@ -78,6 +114,9 @@ class HotnessTracker:
         vmask = (np.ones(flat.shape, bool) if valid is None
                  else np.asarray(valid, bool).reshape(-1))
         out = np.full(flat.shape, -1, np.int32)
+        if observe and self.decay is not None:
+            self._tick_decay()
+        pthr = self.promote_threshold * self._scale
         uniq, inv, counts = np.unique(flat[vmask], return_inverse=True,
                                       return_counts=True)
         slot_of = np.full(uniq.shape, -1, np.int32)
@@ -86,9 +125,14 @@ class HotnessTracker:
             if s is not None:
                 slot_of[u] = s
             if observe:
-                c = self._counts.get(key, 0) + int(counts[u])
+                # stored units are inflated by _scale (lazy decay); with
+                # decay off, _scale stays 1.0 and these are the original
+                # integer counters
+                inc = (int(counts[u]) if self.decay is None
+                       else counts[u] * self._scale)
+                c = self._counts.get(key, 0) + inc
                 self._counts[key] = c
-                if s is None and c >= self.promote_threshold:
+                if s is None and c >= pthr:
                     self._pending.add(key)
         if observe and len(self._counts) > self.max_tracked:
             self._prune_counts()
@@ -103,6 +147,34 @@ class HotnessTracker:
                 valid: Optional[np.ndarray] = None) -> None:
         """Count-only observation (the training warmup scan's form)."""
         self.lookup_slots(keys, valid=valid, observe=True)
+
+    def _tick_decay(self) -> None:
+        """One lazy aging tick: the inflation factor advances (every
+        stored count is now implicitly `decay` smaller in true units —
+        no dict traversal); periodically (DECAY_SWEEP_EVERY ticks, and
+        whenever the factor nears double overflow) the dict is swept:
+        stored values renormalize to the fresh scale, counts aged below
+        DECAY_EPSILON leave (resident keys stay — the eviction policy
+        must always be able to rank them), and pending keys whose aged
+        count fell back under the threshold lose their eligibility."""
+        self._scale /= self.decay
+        self._ticks_since_sweep += 1
+        if (self._ticks_since_sweep < self.DECAY_SWEEP_EVERY
+                and self._scale <= self._SCALE_RENORM):
+            return
+        self._ticks_since_sweep = 0
+        inv = 1.0 / self._scale
+        resident = self._index
+        kept = {}
+        for k, c in self._counts.items():
+            c *= inv                       # back to true units
+            if c >= self.DECAY_EPSILON or k in resident:
+                kept[k] = c
+        self._counts = kept
+        self._scale = 1.0
+        if self._pending:
+            self._pending = {k for k in self._pending
+                             if kept.get(k, 0.0) >= self.promote_threshold}
 
     def _prune_counts(self) -> None:
         """Bound the counter dict: keep resident keys plus the hottest
@@ -120,13 +192,42 @@ class HotnessTracker:
         self._pending &= set(kept)
 
     # ----------------------------------------------------------- admission
-    def _promotion_candidates(self) -> List[Tuple[int, int]]:
-        """Uncached keys whose count crossed the threshold, hottest first —
-        drawn from the `_pending` set, not a full counter scan."""
+    def _promotion_candidates(self) -> List[Tuple[float, int]]:
+        """Uncached keys whose count crossed the threshold, hottest first
+        — drawn from the `_pending` set, not a full counter scan.
+        Returned counts are TRUE (de-inflated) units; pending keys whose
+        count aged back under the threshold are lazily demoted here."""
         self._pending -= set(self._index)
-        cands = [(self._counts.get(k, 0), k) for k in self._pending]
+        if self.decay is not None and self._pending:
+            pthr = self.promote_threshold * self._scale
+            self._pending = {k for k in self._pending
+                             if self._counts.get(k, 0.0) >= pthr}
+        inv = 1.0 / self._scale
+        cands = [(self._counts.get(k, 0) * inv, k) for k in self._pending]
         cands.sort(reverse=True)
         return cands
+
+    def pending_candidates(self) -> List[Tuple[float, int]]:
+        """The (count, key) promotion candidates, hottest first — the
+        `plan_admissions` input exposed for callers that own slot
+        assignment themselves (the vocab manager binds keys through the
+        erasable IntegerLookup rather than this tracker's slot table).
+        Does not mutate pending; pair with `drop_pending` once bound."""
+        return self._promotion_candidates()
+
+    def drop_pending(self, keys) -> None:
+        """Remove keys from the pending set (caller admitted or rejected
+        them through its own binding structure)."""
+        self._pending -= {int(k) for k in np.asarray(keys).reshape(-1)}
+
+    def counts_for(self, keys) -> np.ndarray:
+        """Tracked (possibly decayed) counts for `keys` ([n] float64,
+        0 for untracked, TRUE units) — the eviction policy's coldness
+        ranking."""
+        flat = np.asarray(keys, np.int64).reshape(-1)
+        inv = 1.0 / self._scale
+        return np.asarray([self._counts.get(int(k), 0.0) * inv
+                           for k in flat], np.float64)
 
     def plan_admissions(self) -> List[Tuple[int, int]]:
         """Run the admission policy against the current counters.
@@ -156,7 +257,8 @@ class HotnessTracker:
                               key=lambda s: self._counts.get(
                                   int(self.slot_keys[s]), 0))
                 cold_key = int(self.slot_keys[coldest])
-                if count <= self._counts.get(cold_key, 0):
+                # candidate counts are true units, stored are inflated
+                if count <= self._counts.get(cold_key, 0) / self._scale:
                     break                          # sorted: nothing hotter left
                 self._index.pop(cold_key, None)
                 self.evictions += 1
@@ -192,8 +294,9 @@ class HotnessTracker:
 
     def invalidate(self) -> None:
         """Drop every resident row (hits resume only after re-admission)."""
+        pthr = self.promote_threshold * self._scale
         for k in self._index:
-            if self._counts.get(k, 0) >= self.promote_threshold:
+            if self._counts.get(k, 0) >= pthr:
                 self._pending.add(k)       # still hot: re-promotable
         self._index.clear()
         self.slot_keys.fill(-1)

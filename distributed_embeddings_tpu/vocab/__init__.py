@@ -1,0 +1,10 @@
+"""Dynamic vocabulary management (ISSUE 7): streaming admission of new
+raw keys, cold-row eviction, and recompile-free table growth over
+pre-reserved slack rows. See `vocab.manager` for the design notes."""
+
+from distributed_embeddings_tpu.vocab.manager import (  # noqa: F401
+    ManagedVocab, VocabManager, default_admit_threshold,
+    latest_vocab_state, vocab_state_path)
+
+__all__ = ["ManagedVocab", "VocabManager", "default_admit_threshold",
+           "latest_vocab_state", "vocab_state_path"]

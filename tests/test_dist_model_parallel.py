@@ -75,13 +75,22 @@ def _sgd_respecting_placement(p, g):
 def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
                       seed=0, check_train=True, input_max_hotness=None,
                       rtol=1e-5, atol=1e-5, train_rtol=1e-4, train_atol=1e-5,
-                      store_roundtrip=False, **dist_kwargs):
+                      store_roundtrip=False, vocab_axis=False,
+                      **dist_kwargs):
     """specs: list of (vocab, width) or (vocab, width, combiner).
 
     store_roundtrip (ISSUE 6): materialize the params through the
     versioned table store's publish/consume path (snapshot file ->
     consumer apply) before running the checks, so every equivalence
-    property also holds for store-backed parameters."""
+    property also holds for store-backed parameters.
+
+    vocab_axis (ISSUE 7): run the batch as RAW int64 keys through a
+    `vocab.VocabManager` over a slack-inflated plan — inputs reach the
+    forward as manager-translated physical rows, so every equivalence
+    property also holds for dynamically-bound vocabularies (the
+    reference model sees the same translated rows over zero-padded
+    tables; what this axis exercises is the slack plan + binding
+    composition, per-table and shared-table alike)."""
     rng = np.random.RandomState(seed)
     embeddings = []
     combiners = []
@@ -107,10 +116,52 @@ def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
     weights = [rng.randn(s[0], s[1]).astype(np.float32) * 0.1 for s in specs]
 
     mesh = make_mesh(world) if world > 1 else None
+    if vocab_axis:
+        dist_kwargs.setdefault("vocab_slack", 16)
     dist = DistributedEmbedding(embeddings, mesh=mesh,
                                 input_table_map=input_table_map,
                                 input_max_hotness=input_max_hotness,
                                 **dist_kwargs)
+    if vocab_axis:
+        from distributed_embeddings_tpu.vocab import VocabManager
+
+        # physical shapes are slack-inflated: pad the reference weights
+        # with zero growth rows (both sides read the same padded tables)
+        weights = [
+            np.pad(np.asarray(w, np.float32),
+                   ((0, dist.strategy.global_configs[t]["input_dim"]
+                     - np.asarray(w).shape[0]), (0, 0)))
+            for t, w in enumerate(weights)]
+        mgr = VocabManager(dist, admit_threshold=1, use_native=False)
+
+        def to_raw(vals):
+            # injective map into a far-away int64 raw-key space
+            return np.asarray(jax.device_get(vals),
+                              np.int64) * 97 + 3_000_000_017
+
+        raw_inputs, per_table_raw = [], {}
+        for i, x in enumerate(inputs):
+            t = (list(input_table_map) if input_table_map
+                 else list(range(len(specs))))[i]
+            if t not in mgr.vocabs:
+                raw_inputs.append(x)
+                continue
+            if isinstance(x, RaggedIds):
+                raw = to_raw(x.values)
+                raw_inputs.append(RaggedIds(raw, x.row_splits))
+            elif isinstance(x, SparseIds):
+                raw = to_raw(x.values)
+                raw_inputs.append(SparseIds(x.indices, raw, x.dense_shape))
+            elif isinstance(x, tuple) and len(x) == 2:
+                raw = to_raw(x[0])
+                raw_inputs.append((raw, x[1]))
+            else:
+                raw = to_raw(x)
+                raw_inputs.append(raw)
+            per_table_raw.setdefault(t, []).append(raw.reshape(-1))
+        for t, chunks in per_table_raw.items():
+            mgr.vocabs[t].bind(np.unique(np.concatenate(chunks)))
+        inputs = mgr.translate(raw_inputs)
     params = dist.set_weights(weights)
     if store_roundtrip:
         import tempfile

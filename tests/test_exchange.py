@@ -239,6 +239,45 @@ def test_touched_rows_per_step_schema():
             g["touched_rows_per_step"] * (8 + 4 * bucket.width))
 
 
+def test_vocab_occupancy_report_schema():
+    """Capacity accounting (ISSUE 7): every report group carries
+    `occupancy` (live rows / capacity rows), `slack_rows` (pre-reserved
+    growth rows in the bucket) and `evictions_per_step`; a static plan
+    reads fully-bound/zero, a slack plan with a live VocabManager reads
+    the measured binding state."""
+    specs = [(96, 8, "sum"), (50, 8, "sum"), (100, 16, "sum"),
+             (120, 8, "sum")]
+    dist, _ = make_dist(specs, input_max_hotness=[4, 4, 4, 4])
+    rep = dist.exchange_padding_report()
+    for g in rep["groups"]:
+        assert g["occupancy"] == 1.0          # static vocab: all rows live
+        assert g["slack_rows"] == 0
+        assert g["evictions_per_step"] == 0.0
+    assert rep["occupancy"] == 1.0
+    assert rep["slack_rows"] == 0
+    assert rep["evictions_per_step"] == 0.0
+
+    from distributed_embeddings_tpu.vocab import VocabManager
+    dist_s = DistributedEmbedding(
+        [Embedding(v, w, combiner=c) for v, w, c in specs],
+        mesh=create_mesh(jax.devices()[:8]),
+        input_max_hotness=[4, 4, 4, 4], vocab_slack=16)
+    mgr = VocabManager(dist_s, admit_threshold=1, use_native=False)
+    mgr.vocabs[0].bind([10**9, 10**9 + 1, 10**9 + 2])
+    mgr.maintain_cycles = 2
+    mgr.vocabs[0].evictions = 4
+    rep_s = dist_s.exchange_padding_report(vocab=mgr)
+    assert rep_s["slack_rows"] == sum(
+        b.slack_rows for b in dist_s.plan.tp_buckets)
+    assert rep_s["slack_rows"] > 0
+    assert 0.0 < rep_s["occupancy"] < 1.0     # mostly-unbound manager
+    assert rep_s["evictions_per_step"] == pytest.approx(2.0)
+    for g in rep_s["groups"]:
+        assert 0.0 < g["occupancy"] <= 1.0
+        assert g["slack_rows"] >= 0
+        assert g["evictions_per_step"] >= 0.0
+
+
 def test_one_hot_auto_resolves_basic():
     specs = [(96, 8), (50, 8), (100, 16), (120, 8)]
     dist, _ = make_dist(specs, input_max_hotness=[1, 1, 1, 1])

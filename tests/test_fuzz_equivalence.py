@@ -55,6 +55,12 @@ def gen_config(seed):
         # apply — bit-exact by contract), so every equivalence property
         # in this sweep also runs against store-backed parameters
         kw["store_roundtrip"] = True
+    if rng.rand() < 0.3:
+        # vocab axis (ISSUE 7): the batch arrives as RAW int64 keys and
+        # reaches the forward through a VocabManager binding over a
+        # slack-inflated plan — every equivalence property also holds
+        # for dynamically-bound vocabularies
+        kw["vocab_axis"] = True
     return specs, table_map, kw
 
 

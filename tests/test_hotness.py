@@ -90,6 +90,80 @@ def test_invalidate_reenters_pending():
     assert [k for _, k in plan] == [9]
 
 
+def test_decay_none_is_all_time_counts():
+    """decay=None (and decay=1.0) must keep the original integer
+    all-time counters — the pre-decay callers' policy, bit for bit."""
+    a = HotnessTracker(capacity=4, promote_threshold=2)
+    b = HotnessTracker(capacity=4, promote_threshold=2, decay=1.0)
+    for tr in (a, b):
+        assert tr.decay is None
+        tr.observe(np.array([5]))
+        tr.observe(np.array([5]))
+        assert tr._counts[5] == 2
+        assert [k for _, k in tr.plan_admissions()] == [5]
+
+
+def test_decay_ages_counts_and_pending():
+    """Windowed aging (ISSUE 7): each observing call ages every tracked
+    count (lazily — no per-batch dict sweep), so long-running admission
+    reflects RECENT frequency — an old-hot key must lose promotion
+    eligibility (and eventually tracking) once the stream drifts away
+    from it."""
+    tr = HotnessTracker(capacity=8, promote_threshold=3, decay=0.5)
+    tr.DECAY_SWEEP_EVERY = 4          # test-speed sweep cadence
+    tr.observe(np.repeat(np.array([7]), 6))          # count 6 -> pending
+    assert 7 in tr._pending
+    # drift: key 7 disappears; its true count halves per observation
+    for _ in range(3):
+        tr.observe(np.array([1, 2]))
+    assert tr.counts_for(np.array([7]))[0] < 3
+    assert [k for _, k in tr.pending_candidates()] == []   # aged under
+    assert 7 not in tr._pending
+    # fully aged-out keys leave the dict at the amortized sweep
+    for _ in range(8):
+        tr.observe(np.array([1, 2]))
+    assert 7 not in tr._counts
+
+
+def test_decay_steady_state_crosses_threshold():
+    """A key seen steadily crosses the threshold even under decay (the
+    geometric series converges to rate / (1 - decay)), while a one-off
+    burst below that equilibrium does not stick."""
+    tr = HotnessTracker(capacity=8, promote_threshold=2, decay=0.9)
+    for _ in range(5):
+        tr.observe(np.array([42]))
+    assert [k for _, k in tr.pending_candidates()] == [42]
+    # resident keys keep their (decayed) counts trackable for eviction
+    # ranking even when aged below epsilon
+    tr.commit_admissions(tr.plan_admissions())
+    for _ in range(60):
+        tr.observe(np.array([1]))
+    assert 42 in tr._counts
+    assert tr.counts_for(np.array([42, 1]))[0] < tr.counts_for(
+        np.array([42, 1]))[1]
+
+
+def test_pending_candidates_and_drop_pending():
+    """The external-binding surface (vocab manager): candidates are
+    exposed without slot planning and can be cleared once the caller
+    binds them through its own structure."""
+    tr = HotnessTracker(capacity=4, promote_threshold=2)
+    tr.observe(np.array([5, 5, 9, 9, 9]))
+    cands = tr.pending_candidates()
+    assert [k for _, k in cands] == [9, 5]           # hottest first
+    tr.drop_pending(np.array([9]))
+    assert [k for _, k in tr.pending_candidates()] == [5]
+    np.testing.assert_array_equal(tr.counts_for(np.array([9, 5, 777])),
+                                  [3.0, 2.0, 0.0])
+
+
+def test_decay_rejects_bad_factor():
+    with pytest.raises(ValueError):
+        HotnessTracker(capacity=2, decay=0.0)
+    with pytest.raises(ValueError):
+        HotnessTracker(capacity=2, decay=1.5)
+
+
 def test_serving_cache_delegates_to_tracker():
     """The cache's host-side surface IS the tracker (no drift possible):
     its dict/array views alias the tracker's own state."""

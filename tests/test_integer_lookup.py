@@ -57,6 +57,86 @@ def test_native_matches_numpy():
     assert a.get_vocabulary() == b.get_vocabulary()
 
 
+@pytest.mark.parametrize("use_native", [True, False])
+def test_erase_and_free_slot_reuse(use_native):
+    """Eviction surface (ISSUE 7): erase releases a key's index back to
+    a free list that later insertions reuse (LIFO) before minting new
+    indices; erased keys read as OOV; counts reset so a reused index
+    never inherits its previous tenant's frequency."""
+    layer = IntegerLookup(max_tokens=6, use_native=use_native)
+    if use_native and not layer.native:
+        pytest.skip("native backend unavailable")
+    assert layer(np.array([10, 20, 30], np.int64)).tolist() == [1, 2, 3]
+    freed = layer.erase(np.array([20, 99], np.int64))
+    assert freed.tolist() == [2, 0]          # 99 was never bound
+    assert layer.free_slots().tolist() == [2]
+    assert layer.lookup(np.array([20]))[0] == 0
+    assert layer.size == 3                   # 10, 30 + OOV
+    # get_vocabulary keeps later keys index-aligned via a None hole
+    assert layer.get_vocabulary() == [-1, 10, None, 30]
+    # reuse: freed index first, then a fresh one past the high water
+    assert layer(np.array([40, 50], np.int64)).tolist() == [2, 4]
+    assert layer.free_slots().tolist() == []
+    assert layer.get_vocabulary() == [-1, 10, 40, 30, 50]
+    c = layer.counts()
+    assert c[2] == 1                         # 40's count, not 20's
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_erase_capacity_recovers(use_native):
+    """A full table that erases a key can admit a new one — the bounded
+    table follows an unbounded key space."""
+    layer = IntegerLookup(max_tokens=2, use_native=use_native)
+    if use_native and not layer.native:
+        pytest.skip("native backend unavailable")
+    assert layer(np.array([10, 20, 30], np.int64)).tolist() == [1, 2, 0]
+    layer.erase(np.array([10], np.int64))
+    assert layer(np.array([30], np.int64)).tolist() == [1]
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_reserved_sentinel_keys_map_to_oov(use_native):
+    """The native map's slot sentinels (INT64_MIN, INT64_MIN+1 — empty
+    and tombstone) are RESERVED key values on both backends: they
+    translate to OOV on every path and are never stored (a stored
+    sentinel would corrupt probe chains / hole exports)."""
+    layer = IntegerLookup(max_tokens=8, use_native=use_native)
+    if use_native and not layer.native:
+        pytest.skip("native backend unavailable")
+    lo = np.iinfo(np.int64).min
+    keys = np.array([lo, lo + 1, 5], np.int64)
+    out = layer(keys)
+    assert out.tolist() == [0, 0, 1]          # sentinels -> OOV, 5 binds
+    assert layer.lookup(keys).tolist() == [0, 0, 1]
+    assert layer.erase(keys[:2]).tolist() == [0, 0]
+    assert layer.size == 2                    # only {5} + OOV
+    assert layer.get_vocabulary() == [-1, 5]
+    # a probe chain crossing where a sentinel "key" would have sat stays
+    # intact under further churn
+    layer(np.array([lo, 6, 7], np.int64))
+    assert layer.lookup(np.array([5, 6, 7])).tolist() == [1, 2, 3]
+
+
+def test_erase_native_matches_numpy_under_churn():
+    """Random insert/erase churn (deep enough to trigger the native
+    map's tombstone rehash) keeps both backends byte-identical —
+    indices, free lists, vocabulary and query lookups."""
+    nat = IntegerLookup(max_tokens=200, use_native=True)
+    if not nat.native:
+        pytest.skip("native backend unavailable")
+    ref = IntegerLookup(max_tokens=200, use_native=False)
+    rng = np.random.RandomState(0)
+    for _ in range(30):
+        keys = rng.randint(0, 400, size=300).astype(np.int64)
+        np.testing.assert_array_equal(nat(keys), ref(keys))
+        dead = rng.choice(400, size=40, replace=False).astype(np.int64)
+        np.testing.assert_array_equal(nat.erase(dead), ref.erase(dead))
+        np.testing.assert_array_equal(nat.free_slots(), ref.free_slots())
+        assert nat.get_vocabulary() == ref.get_vocabulary()
+        probe = rng.randint(0, 500, size=64).astype(np.int64)
+        np.testing.assert_array_equal(nat.lookup(probe), ref.lookup(probe))
+
+
 def test_io_callback_under_jit():
     import jax
     import jax.numpy as jnp

@@ -372,3 +372,71 @@ def test_engine_streaming_consumption(tmp_path):
     assert engine.store.version == prod.version == 4
     for a, b in zip(prod.get_weights(), engine.store.get_weights()):
         np.testing.assert_array_equal(b, a)
+
+
+def test_vocab_binding_sidecar_roundtrip(tmp_path):
+    """Dynamic-vocabulary sidecars (ISSUE 7): the binding table + slot
+    free-list publish next to the row stream (`vocab_v{V}.npz`), scan by
+    version, and rebuild a fresh manager's binding bit-exactly — the
+    piece of vocab state that must survive train-to-serve handoff and
+    checkpoint restore alongside the rows."""
+    from distributed_embeddings_tpu.vocab import (VocabManager,
+                                                  latest_vocab_state,
+                                                  vocab_state_path)
+
+    mesh = create_mesh(jax.devices()[:8])
+    emb = DistributedEmbedding(
+        [Embedding(v, w, combiner="sum") for v, w in SIZES],
+        mesh=mesh, strategy="memory_balanced", row_slice_threshold=30000,
+        vocab_slack=8)
+    mgr = VocabManager(emb, admit_threshold=1, decay=0.9, use_native=False)
+    rng = np.random.RandomState(5)
+    params = emb.init(jax.random.PRNGKey(0))
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for r in range(4):
+            raw = [rng.randint(10**8 + r * 30, 10**8 + r * 30 + 40,
+                               (16, 2)).astype(np.int64) for _ in SIZES]
+            mgr.translate(raw, observe=True)
+            params, _ = mgr.maintain(params)
+    assert mgr.stats()["admissions"] > 0
+
+    d = str(tmp_path)
+    mgr.save_state(vocab_state_path(d, 3))
+    mgr.save_state(vocab_state_path(d, 7))
+    assert latest_vocab_state(d) == vocab_state_path(d, 7)
+    assert latest_vocab_state(d, upto=5) == vocab_state_path(d, 3)
+    assert latest_vocab_state(d, upto=1) is None
+
+    fresh = VocabManager(emb, use_native=False)
+    fresh.load_state(latest_vocab_state(d))
+    probe = rng.randint(10**8, 10**8 + 200, 256).astype(np.int64)
+    for t in mgr.vocabs:
+        np.testing.assert_array_equal(fresh.vocabs[t].resident_keys(),
+                                      mgr.vocabs[t].resident_keys())
+        np.testing.assert_array_equal(
+            fresh.vocabs[t].binding.free_slots(),
+            mgr.vocabs[t].binding.free_slots())
+        np.testing.assert_array_equal(fresh.vocabs[t].binding.lookup(probe),
+                                      mgr.vocabs[t].binding.lookup(probe))
+        # decayed counters survive too (eviction ranking after restore)
+        np.testing.assert_allclose(
+            fresh.vocabs[t].tracker.counts_for(probe),
+            mgr.vocabs[t].tracker.counts_for(probe))
+
+    # the ADMISSION POLICY restores with the state: a manager built with
+    # different defaults resumes the SAVED threshold/decay, not its own
+    assert fresh.admit_threshold == 1
+    assert all(mv.tracker.promote_threshold == 1
+               and mv.tracker.decay == 0.9
+               for mv in fresh.vocabs.values())
+
+    # a manager over a DIFFERENT slack (capacity) refuses the state
+    emb2 = DistributedEmbedding(
+        [Embedding(v, w, combiner="sum") for v, w in SIZES],
+        mesh=mesh, strategy="memory_balanced", row_slice_threshold=30000,
+        vocab_slack=32)
+    other = VocabManager(emb2, use_native=False)
+    with pytest.raises(ValueError, match="capacity"):
+        other.load_state(latest_vocab_state(d))

@@ -209,6 +209,112 @@ def test_store_delta_roundtrip_bitexact(optimizer, ragged, hot, tmp_path,
                           f"hot={hot})")
 
 
+# mid-growth vocab round-trips (ISSUE 7): adagrad rides tier-1, the
+# other optimizers the slow tier (each combo compiles its own train step)
+_VOCAB_CKPT_MATRIX = [
+    pytest.param(o, marks=([] if o == "adagrad" else [pytest.mark.slow]))
+    for o in ("sgd", "adagrad", "adam")
+]
+
+
+@pytest.mark.parametrize("optimizer", _VOCAB_CKPT_MATRIX)
+def test_vocab_midgrowth_store_roundtrip_bitexact(optimizer, tmp_path):
+    """A mid-growth table (admit -> evict -> re-admit between training
+    steps, with the row inits/restores that implies) must round-trip
+    the publish stream bit-exactly: restore_from_published reconstructs
+    the publisher's get_weights at the final version, and the binding
+    sidecar reconstructs the key->row map — across every sparse
+    optimizer (the eviction/rebind path zeroes optimizer-state rows, so
+    each rule's laziness is exercised)."""
+    import warnings
+    from distributed_embeddings_tpu.store import (TableStore,
+                                                  restore_from_published)
+    from distributed_embeddings_tpu.training import make_sparse_train_step
+    from distributed_embeddings_tpu.vocab import VocabManager
+
+    mesh = create_mesh(jax.devices()[:8])
+    emb = DistributedEmbedding(
+        [Embedding(v, w, combiner="sum") for v, w in SIZES],
+        mesh=mesh, strategy="memory_balanced", row_slice_threshold=30000,
+        vocab_slack=16)
+    mgr = VocabManager(emb, admit_threshold=1, decay=0.9, use_native=False,
+                       high_watermark=0.5, low_watermark=0.25)
+
+    class _M:
+        def __init__(self):
+            self.embedding = emb
+
+        def loss_fn(self, params, numerical, cats, labels, taps=None,
+                    return_residuals=False):
+            if taps is not None or return_residuals:
+                outs, res = self.embedding.apply(
+                    params["embedding"], cats, taps=taps,
+                    return_residuals=True)
+            else:
+                outs, res = self.embedding.apply(params["embedding"],
+                                                 cats), None
+            x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs],
+                                axis=1)
+            loss = jnp.mean((jnp.sum(x, axis=1) - labels.reshape(-1)) ** 2)
+            return (loss, res) if return_residuals else loss
+
+    rng = np.random.RandomState(13)
+    model = _M()
+    init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.1)
+    p = {"embedding": emb.init(jax.random.PRNGKey(0))}
+    s = init_fn(p)
+    store = TableStore(emb, p["embedding"], s["emb"])
+    d = str(tmp_path / "stream")
+    store.commit(p["embedding"], s["emb"])
+    assert store.publish(d)["kind"] == "snapshot"
+    mgr.save_state(str(tmp_path / "stream" / "vocab_v00000001.npz"))
+
+    def raw_batch(universe):
+        cats = [np.asarray(rng.randint(universe, universe + 40, (16, 2)),
+                           np.int64) for _ in SIZES]
+        return cats, jnp.asarray(rng.randn(16).astype(np.float32))
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for step in range(4):
+            # rotate the key universe: admissions AND evictions between
+            # every publish (the mid-growth part of the contract)
+            cats_raw, labels = raw_batch(10**8 + step * 25)
+            for _ in range(2):
+                mgr.translate(cats_raw, observe=True)
+            cats = mgr.translate(cats_raw, observe=True)
+            p_emb, s_emb = mgr.maintain(p["embedding"], s["emb"])
+            p = {"embedding": p_emb}
+            s = {**s, "emb": s_emb}
+            store.observe(cats)
+            p, s, _ = step_fn(p, s, jnp.zeros((16, 1)),
+                              [jnp.asarray(c) for c in cats], labels)
+            store.commit(p["embedding"], s["emb"],
+                         touched=mgr.drain_touched())
+            info = store.publish(d)
+            mgr.save_state(str(
+                tmp_path / "stream" / f"vocab_v{info['version']:08d}.npz"))
+    st = mgr.stats()
+    assert st["admissions"] > 0 and st["evictions"] > 0, st
+
+    want = emb.get_weights(p["embedding"])
+    rstore = restore_from_published(emb, d)
+    assert rstore.version == store.version
+    for t, (a, b) in enumerate(zip(want, emb.get_weights(rstore.params))):
+        np.testing.assert_array_equal(
+            b, a, err_msg=f"table {t} ({optimizer})")
+    # the binding sidecar restores the key->row map at the same version
+    from distributed_embeddings_tpu.vocab import latest_vocab_state
+    mgr2 = VocabManager(emb, use_native=False)
+    mgr2.load_state(latest_vocab_state(d, upto=rstore.version))
+    for t in mgr.vocabs:
+        np.testing.assert_array_equal(mgr2.vocabs[t].resident_keys(),
+                                      mgr.vocabs[t].resident_keys())
+        np.testing.assert_array_equal(
+            mgr2.vocabs[t].binding.free_slots(),
+            mgr.vocabs[t].binding.free_slots())
+
+
 def test_distributed_optimizer_postprocess():
     """DistributedOptimizer's gradient-postprocess hook must actually shape
     the update (reference: gradient postprocessing via the wrapped

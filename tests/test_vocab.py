@@ -1,0 +1,441 @@
+"""Dynamic vocabulary manager tests (ISSUE 7): frequency-gated
+admission, watermark eviction with host-side demotion, recompile-free
+growth over pre-reserved slack rows, binding round-trips, and the
+fit/publish/serve integration."""
+
+import os
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_embeddings_tpu.layers.embedding import Embedding
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    DistributedEmbedding)
+from distributed_embeddings_tpu.parallel.mesh import create_mesh
+from distributed_embeddings_tpu.vocab import (VocabManager,
+                                              latest_vocab_state,
+                                              vocab_state_path)
+
+SIZES = [(48, 8), (32, 8), (100, 8), (64, 8)]
+
+
+def make_emb(slack=16, **kw):
+    mesh = create_mesh(jax.devices()[:8])
+    kw.setdefault("strategy", "memory_balanced")
+    return DistributedEmbedding(
+        [Embedding(v, w, combiner="sum") for v, w in SIZES],
+        mesh=mesh, vocab_slack=slack, **kw)
+
+
+class _M:
+    def __init__(self, emb):
+        self.embedding = emb
+
+    def loss_fn(self, params, numerical, cats, labels, taps=None,
+                return_residuals=False):
+        if taps is not None or return_residuals:
+            outs, res = self.embedding.apply(
+                params["embedding"], cats, taps=taps, return_residuals=True)
+        else:
+            outs, res = self.embedding.apply(params["embedding"], cats), None
+        x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs],
+                            axis=1)
+        loss = jnp.mean((jnp.sum(x, axis=1) - labels.reshape(-1)) ** 2)
+        return (loss, res) if return_residuals else loss
+
+
+def test_slack_zero_is_plan_noop(monkeypatch):
+    """vocab_slack=0 (and the env default) must produce byte-identical
+    plans to the pre-slack code path — the bit-exactness acceptance for
+    managed-off mode rides on this."""
+    mesh = create_mesh(jax.devices()[:8])
+
+    def build(**kw):
+        return DistributedEmbedding(
+            [Embedding(v, w, combiner="sum") for v, w in SIZES],
+            mesh=mesh, strategy="memory_balanced", **kw)
+
+    base = build()
+    z = build(vocab_slack=0)
+    assert [b.rows for b in z.plan.tp_buckets] == \
+        [b.rows for b in base.plan.tp_buckets]
+    assert all(b.slack_rows == 0 for b in z.plan.tp_buckets)
+    assert all("vocab_slack" not in c for c in z.strategy.global_configs)
+    monkeypatch.setenv("DET_VOCAB_SLACK", "8")
+    env = build()
+    assert env.strategy.vocab_slack == 8
+    assert all(b.slack_rows > 0 for b in env.plan.tp_buckets)
+    for gtid in env.strategy.table_groups[1]:
+        cfg = env.strategy.global_configs[gtid]
+        assert cfg["input_dim"] == cfg["vocab_base_rows"] + 8
+
+
+def test_admission_eviction_growth_cycle():
+    """The core policy loop: unknown keys ride the fallback row until
+    their decayed count crosses the threshold; admission binds them to
+    free slots (zero-initialized rows); drift pressure evicts the cold
+    tail at the watermark (rows stashed host-side); a re-admitted key
+    gets its stashed row back; occupancy never exceeds the high
+    watermark."""
+    emb = make_emb(slack=8)
+    params = emb.init(jax.random.PRNGKey(0))
+    mgr = VocabManager(emb, admit_threshold=2, decay=0.9, use_native=False,
+                       high_watermark=0.5, low_watermark=0.25)
+    rng = np.random.RandomState(0)
+    raw = [rng.randint(10**9, 2 * 10**9, size=(16, 2)).astype(np.int64)
+           for _ in SIZES]
+
+    # below threshold: everything translates to the fallback row
+    t0 = mgr.translate(raw, observe=True)
+    assert all((np.asarray(x) == 0).all() for x in t0)
+    params, _ = mgr.maintain(params)
+    assert mgr.stats()["admissions"] == 0       # count 1 < threshold 2
+
+    # sustained signal crosses the threshold -> bound to private rows
+    for _ in range(3):
+        mgr.translate(raw, observe=True)
+    params, _ = mgr.maintain(params)
+    t1 = mgr.translate(raw)
+    assert any((np.asarray(x) > 0).any() for x in t1)
+    st = mgr.stats()
+    assert st["admissions"] > 0
+
+    # admitted rows were zero-initialized (slack rows carried init noise)
+    w = emb.get_weights(params)
+    rows0 = np.unique(np.asarray(t1[0]).reshape(-1))
+    rows0 = rows0[rows0 > 0]
+    assert (w[0][rows0] == 0).all()
+
+    # drift: new key universes force watermark eviction, occupancy
+    # stays <= high watermark at every cycle
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for r in range(6):
+            raw2 = [rng.randint(10**9, 2 * 10**9,
+                                size=(16, 2)).astype(np.int64)
+                    for _ in SIZES]
+            for _ in range(3):
+                mgr.translate(raw2, observe=True)
+            params, _ = mgr.maintain(params)
+            for mv in mgr.vocabs.values():
+                assert mv.occupancy <= 0.5 + 1e-9
+    st = mgr.stats()
+    assert st["evictions"] > 0
+    assert any(len(mv.stash) for mv in mgr.vocabs.values())
+
+    # a stable universe must NOT churn once resident
+    emb2 = make_emb(slack=8)
+    p2 = emb2.init(jax.random.PRNGKey(1))
+    m2 = VocabManager(emb2, admit_threshold=1, decay=0.9, use_native=False)
+    fixed = [rng.randint(0, 30, size=(16, 2)).astype(np.int64) + 10**9
+             for _ in SIZES]
+    for _ in range(6):
+        m2.translate(fixed, observe=True)
+        p2, _ = m2.maintain(p2)
+    assert m2.stats()["evictions"] == 0
+
+
+def test_stash_restores_trained_row():
+    """Evict -> re-admit must hand the key its trained row back (the
+    host-offloaded demotion storage), not a fresh zero row."""
+    emb = make_emb(slack=8)
+    params = emb.init(jax.random.PRNGKey(1))
+    mgr = VocabManager(emb, admit_threshold=1, decay=0.9, use_native=False,
+                       high_watermark=0.9, low_watermark=0.3)
+    rng = np.random.RandomState(3)
+    key_a = np.full((4, 2), 777_777, np.int64)
+    quiet = np.zeros((4, 2), np.int64)
+    mgr.translate([key_a, quiet, quiet, quiet], observe=True)
+    params, _ = mgr.maintain(params)
+    row_a = int(mgr.vocabs[0].binding.lookup(np.array([777_777]))[0])
+    assert row_a > 0
+    w = emb.get_weights(params)
+    w[0][row_a] = 42.0
+    params = emb.set_weights(w)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for _ in range(60):     # key_a goes cold; hot flood drifts in
+            flood = rng.randint(10**6, 10**7,
+                                size=(16, 4)).astype(np.int64)
+            mgr.translate([flood, quiet, quiet, quiet], observe=True)
+            mgr.translate([flood, quiet, quiet, quiet], observe=True)
+            params, _ = mgr.maintain(params)
+            if mgr.vocabs[0].binding.lookup(np.array([777_777]))[0] == 0:
+                break
+    assert mgr.vocabs[0].binding.lookup(np.array([777_777]))[0] == 0
+    assert (mgr.vocabs[0].stash[777_777] == 42.0).all()
+
+    for _ in range(30):
+        mgr.translate([key_a, quiet, quiet, quiet], observe=True)
+    params, _ = mgr.maintain(params)
+    row_a2 = int(mgr.vocabs[0].binding.lookup(np.array([777_777]))[0])
+    assert row_a2 > 0
+    w2 = emb.get_weights(params)
+    np.testing.assert_array_equal(w2[0][row_a2], np.full((8,), 42.0))
+
+
+def test_translate_forms_and_drop_mode():
+    """Translation preserves every prepared-input form; on_miss='drop'
+    zero-weights unadmitted lanes instead of routing them to row 0."""
+    from distributed_embeddings_tpu.ops.embedding_ops import (RaggedIds,
+                                                              SparseIds)
+    emb = make_emb(slack=8)
+    mgr = VocabManager(emb, admit_threshold=1, use_native=False)
+    known = mgr.vocabs[0]
+    rows = known.bind([111, 222])
+    assert (np.asarray(rows) > 0).all()
+
+    dense = np.array([[111, 222], [333, 111]], np.int64)
+    out = mgr.translate([dense, np.zeros((2, 2), np.int64),
+                         np.zeros((2, 2), np.int64),
+                         np.zeros((2, 2), np.int64)])
+    o = np.asarray(out[0])
+    assert o[0, 0] == rows[0] and o[0, 1] == rows[1]
+    assert o[1, 0] == 0 and o[1, 1] == rows[0]      # 333 unadmitted
+
+    wts = np.ones((2, 2), np.float32)
+    out_t = mgr.translate([(dense, wts)] + [np.zeros((2, 2), np.int64)] * 3)
+    ids_t, w_t = out_t[0]
+    np.testing.assert_array_equal(np.asarray(ids_t), o)
+    np.testing.assert_array_equal(np.asarray(w_t), wts)
+
+    rag = RaggedIds(jnp.asarray(np.array([111, 333, 222], np.int32)),
+                    jnp.asarray(np.array([0, 2, 3], np.int32)))
+    out_r = mgr.translate([rag] + [np.zeros((2, 2), np.int64)] * 3)
+    np.testing.assert_array_equal(np.asarray(out_r[0].values),
+                                  [rows[0], 0, rows[1]])
+    sp = SparseIds(jnp.asarray(np.array([[0, 0], [1, 1]], np.int32)),
+                   jnp.asarray(np.array([222, 333], np.int32)), (2, 2))
+    out_s = mgr.translate([sp] + [np.zeros((2, 2), np.int64)] * 3)
+    np.testing.assert_array_equal(np.asarray(out_s[0].values), [rows[1], 0])
+
+    # drop mode: unadmitted lanes become zero-weight (no fallback-row
+    # gradient traffic); bound lanes keep their weight
+    mgr_d = VocabManager(emb, admit_threshold=1, use_native=False,
+                         on_miss="drop")
+    mgr_d.vocabs[0].bind([111])
+    dense8 = np.zeros((8, 2), np.int64)     # batch divisible by the mesh
+    dense8[0] = [111, 222]                  # one bound, one unadmitted
+    dense8[1] = [333, 444]                  # both unadmitted
+    ids_d, w_d = mgr_d.translate(
+        [dense8] + [np.zeros((8, 2), np.int64)] * 3)[0]
+    assert w_d[0, 0] == 1.0 and w_d[0, 1] == 0.0 and (w_d[1] == 0.0).all()
+    ones = [np.ones((cfg["input_dim"], cfg["output_dim"]), np.float32)
+            for cfg in emb.strategy.global_configs]
+    out_fwd = emb.apply(emb.set_weights(ones),
+                        [(ids_d, w_d)] + [np.zeros((8, 2), np.int32)] * 3)
+    # exactly one surviving lane in sample 0; sample 1 fully dropped
+    np.testing.assert_allclose(np.asarray(out_fwd[0])[0], np.ones((8,)))
+    np.testing.assert_allclose(np.asarray(out_fwd[0])[1], np.zeros((8,)))
+
+
+def test_compile_count_stable_across_growth():
+    """Admission/eviction/growth never change jitted step shapes: ONE
+    compile per (plan, batch shape) for both the serving forward and the
+    sparse train step, across cycles that bind, evict and rebind rows."""
+    from distributed_embeddings_tpu.training import make_sparse_train_step
+
+    emb = make_emb(slack=8)
+    model = _M(emb)
+    params = {"embedding": emb.init(jax.random.PRNGKey(0))}
+    init_fn, step_fn = make_sparse_train_step(model, "adagrad", lr=0.05,
+                                              donate=False)
+    state = init_fn(params)
+    mgr = VocabManager(emb, admit_threshold=1, decay=0.9, use_native=False,
+                       high_watermark=0.5, low_watermark=0.25)
+    fwd = jax.jit(lambda p, cats: emb.apply(p, cats))
+    step = jax.jit(step_fn, donate_argnums=())
+    rng = np.random.RandomState(0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for r in range(4):
+            raw = [rng.randint(10**9, 2 * 10**9,
+                               size=(16, 2)).astype(np.int64)
+                   for _ in SIZES]
+            for _ in range(2):
+                cats = mgr.translate(raw, observe=True)
+            p_emb, s_emb = mgr.maintain(params["embedding"], state["emb"])
+            params = {**params, "embedding": p_emb}
+            state = {**state, "emb": s_emb}
+            fwd(params["embedding"], [jnp.asarray(c) for c in cats])
+            params, state, loss = step(
+                params, state, jnp.zeros((16, 1)),
+                [jnp.asarray(c) for c in cats],
+                jnp.zeros((16,), jnp.float32))
+            assert np.isfinite(float(loss))
+    st = mgr.stats()
+    assert st["admissions"] > 0 and st["evictions"] > 0
+    assert fwd._cache_size() == 1, "forward recompiled under growth"
+    assert step._cache_size() == 1, "train step recompiled under growth"
+
+
+def test_fit_publish_serve_roundtrip(tmp_path, monkeypatch):
+    """training.fit(vocab=) on raw keys publishes rows + the binding
+    sidecar; a fresh consumer engine polls both and serves the SAME raw
+    keys bit-exactly against the publisher's view."""
+    from distributed_embeddings_tpu import training
+    from distributed_embeddings_tpu.serving import InferenceEngine
+    from distributed_embeddings_tpu.store import TableStore
+
+    monkeypatch.setenv("DET_STEP_DONATE", "0")
+    emb = make_emb(slack=16)
+    model = _M(emb)
+    params = {"embedding": emb.init(jax.random.PRNGKey(0))}
+    mgr = VocabManager(emb, admit_threshold=1, decay=0.99,
+                       use_native=False)
+    rng = np.random.RandomState(7)
+
+    def data(step):
+        cats = [rng.randint(10**8, 10**8 + 60,
+                            size=(16, 2)).astype(np.int64) for _ in SIZES]
+        return (np.zeros((16, 1), np.float32), cats,
+                rng.randn(16).astype(np.float32))
+
+    init_fn, _ = training.make_sparse_train_step(model, "adagrad", lr=0.05)
+    store = TableStore(emb, params["embedding"], init_fn(params)["emb"])
+    d = str(tmp_path / "stream")
+    params, opt, hist = training.fit(
+        model, params, data, steps=9, optimizer="adagrad", lr=0.05,
+        vocab=mgr, vocab_every=3, store=store, publish_every=3,
+        publish_dir=d, log_every=0)
+    assert hist["vocab_stats"]["admissions"] > 0
+    assert hist["published"][0]["kind"] == "snapshot"
+    assert latest_vocab_state(d) is not None
+    assert os.path.exists(vocab_state_path(d, store.version))
+
+    emb_c = make_emb(slack=16)
+    mgr_c = VocabManager(emb_c, use_native=False)
+    eng = InferenceEngine(emb_c, emb_c.init(jax.random.PRNGKey(9)),
+                          vocab_manager=mgr_c)
+    infos = eng.poll_updates(d)
+    assert infos and infos[0]["kind"] == "snapshot"
+    for t in mgr.vocabs:
+        np.testing.assert_array_equal(mgr_c.vocabs[t].resident_keys(),
+                                      mgr.vocabs[t].resident_keys())
+    raw = [rng.randint(10**8, 10**8 + 60, size=(8, 2)).astype(np.int64)
+           for _ in SIZES]
+    out_c = eng.predict(raw)
+    out_p = emb.apply(params["embedding"], mgr.translate(raw))
+    for i, (a, b) in enumerate(zip(out_p, out_c)):
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(a),
+                                      err_msg=f"output {i}")
+
+
+def test_shared_table_decays_once_per_batch():
+    """A table fed by k inputs (input_table_map) must age its admission
+    counters ONCE per batch, not k times — the aging window is a
+    property of the table, not of its input fan-in."""
+    mesh = create_mesh(jax.devices()[:8])
+    emb = DistributedEmbedding(
+        [Embedding(v, w, combiner="sum") for v, w in SIZES],
+        mesh=mesh, strategy="memory_balanced", vocab_slack=8,
+        input_table_map=[0, 1, 2, 3, 0])      # table 0 shared by 2 inputs
+    mgr = VocabManager(emb, admit_threshold=3, decay=0.5, use_native=False)
+    key = np.full((8, 1), 123_456, np.int64)
+    quiet = np.zeros((8, 1), np.int64)
+    batch = [key, quiet, quiet, quiet, quiet]
+    mgr.translate(batch, observe=True)        # count(K) = 8 (8 lanes)
+    mgr.translate(batch, observe=True)        # one tick: 8*0.5 + 8 = 12
+    got = mgr.vocabs[0].tracker.counts_for(np.array([123_456]))[0]
+    assert got == pytest.approx(12.0), got    # double-tick would give 10
+    # the shared inputs' streams still AGGREGATE into one observation
+    batch2 = [key, quiet, quiet, quiet, key]  # K now via both inputs
+    mgr.translate(batch2, observe=True)       # 12*0.5 + 16 = 22
+    got = mgr.vocabs[0].tracker.counts_for(np.array([123_456]))[0]
+    assert got == pytest.approx(22.0), got
+
+
+def test_stash_is_bounded():
+    """The demotion stash must not grow with run length: past stash_max
+    the oldest demotion drops (its key re-admits from zeros)."""
+    emb = make_emb(slack=8)
+    mgr = VocabManager(emb, admit_threshold=1, use_native=False,
+                       stash_max=3)
+    mv = mgr.vocabs[0]
+    for k in range(10):
+        mv.bind([1000 + k])
+        mv.unbind(np.array([1000 + k]),
+                  np.full((1, 8), float(k), np.float32))
+    assert len(mv.stash) == 3
+    assert sorted(mv.stash) == [1007, 1008, 1009]   # newest survive
+
+
+def test_poll_picks_up_late_sidecar_without_new_rows(tmp_path):
+    """A consumer that applied rows before the matching binding sidecar
+    was visible must pick the sidecar up on its NEXT poll — even though
+    no new row files arrive in between (the publisher writes sidecars
+    first, but a consumer can race a partially-synced directory)."""
+    from distributed_embeddings_tpu.serving import InferenceEngine
+    from distributed_embeddings_tpu.store import TableStore
+
+    emb = make_emb(slack=16)
+    params = emb.init(jax.random.PRNGKey(0))
+    mgr = VocabManager(emb, admit_threshold=1, use_native=False)
+    mgr.vocabs[0].bind([111, 222])
+    store = TableStore(emb, params)
+    d = str(tmp_path)
+    store.commit(params)
+    store.publish(d)                      # rows v1, NO sidecar yet
+
+    emb_c = make_emb(slack=16)
+    mgr_c = VocabManager(emb_c, use_native=False)
+    eng = InferenceEngine(emb_c, emb_c.init(jax.random.PRNGKey(1)),
+                          vocab_manager=mgr_c)
+    infos = eng.poll_updates(d)
+    assert infos and mgr_c.vocabs[0].bound == 0   # sidecar wasn't there
+
+    mgr.save_state(vocab_state_path(d, 1))        # sidecar lands late
+    assert eng.poll_updates(d) == []              # no new rows...
+    assert mgr_c.vocabs[0].bound == 2             # ...binding loaded anyway
+
+
+def test_vocab_manager_rejects_bad_configs():
+    emb = make_emb(slack=8)
+    with pytest.raises(ValueError):
+        VocabManager(emb, on_miss="nonsense")
+    with pytest.raises(ValueError):
+        VocabManager(emb, high_watermark=0.5, low_watermark=0.9)
+    with pytest.raises(ValueError):
+        VocabManager(emb, tables=[999])
+    # combiner-None tables cannot ride drop mode
+    mesh = create_mesh(jax.devices()[:8])
+    emb_n = DistributedEmbedding(
+        [Embedding(v, w, combiner=None) for v, w in SIZES[:4]],
+        mesh=mesh, vocab_slack=4)
+    with pytest.raises(ValueError):
+        VocabManager(emb_n, on_miss="drop")
+    # hot-row-replicated buckets are refused: eviction/rebind would
+    # fight sync_hot_rows' write-back over physical rows
+    emb_h = DistributedEmbedding(
+        [Embedding(v, w, combiner="sum") for v, w in SIZES],
+        mesh=mesh, strategy="memory_balanced", vocab_slack=8, hot_rows=8)
+    assert emb_h._hot_buckets
+    with pytest.raises(ValueError, match="hot"):
+        VocabManager(emb_h, tables=[0])
+    with pytest.raises(ValueError, match="manageable"):
+        VocabManager(emb_h)          # nothing left to manage -> loud
+
+
+def test_replan_recommendation_logged():
+    """Admission demand beyond post-eviction capacity must surface the
+    re-plan recommendation (the operator's cue to raise DET_VOCAB_SLACK)."""
+    emb = make_emb(slack=0)
+    params = emb.init(jax.random.PRNGKey(0))
+    logs = []
+    mgr = VocabManager(emb, admit_threshold=1, decay=1.0, use_native=False,
+                       tables=[1], log_fn=logs.append)
+    rng = np.random.RandomState(0)
+    quiet = np.zeros((4, 2), np.int64)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for _ in range(3):
+            flood = rng.randint(10**6, 10**7,
+                                size=(64, 2)).astype(np.int64)
+            mgr.translate([quiet, flood, quiet, quiet], observe=True)
+            params, _ = mgr.maintain(params)
+    assert any("vocab_slack" in str(x.message) for x in w)
+    assert logs and "re-plan" in logs[0]
