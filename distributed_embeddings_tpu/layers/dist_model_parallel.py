@@ -498,6 +498,11 @@ class DistributedEmbedding:
         # offloaded-bucket lookup in tapless forwards — the HBM hot-row
         # cache in `serving/` plugs in here
         self._offload_lookup_override = None
+        # lookahead pipeline hook (ISSUE 9, see staged_exchange_scope):
+        # when set, apply() consumes these prefetched (ex_list, row_outs)
+        # instead of running the exchange — the dense stage of
+        # schedule.LookaheadEngine's fused step plugs in here
+        self._staged_exchange = None
         # (bucket, f_max, k) -> "ragged"|"padded": the exchange path each
         # group actually took (filled at trace time, see _use_ragged_exchange)
         self._exchange_path_taken: dict = {}
@@ -808,7 +813,7 @@ class DistributedEmbedding:
 
     def exchange_padding_report(self, hotness=None,
                                 hot_hit_rate=None, batch: int = 1,
-                                vocab=None) -> dict:
+                                vocab=None, lookahead: int = 0) -> dict:
         """Static accounting of the dp->mp id-exchange volume.
 
         The exchange sends one dense [world, f_max, k] id block per
@@ -878,6 +883,26 @@ class DistributedEmbedding:
         (measured demotions per maintain cycle from the manager, 0.0
         without one). Top-level totals aggregate the same three.
 
+        Lookahead prefetch (ISSUE 9): with ``lookahead > 0`` every group
+        also carries the overlap-window accounting of the pipelined step:
+
+          prefetch_patch_rows_per_step  worst-case rows the engine's
+                            correctness patch re-publishes per step — the
+                            previous batch's touched rows all reappearing
+                            in the prefetched batch, i.e. exactly
+                            `touched_rows_per_step` (the dedup bound
+                            carries over; the measured intersection is
+                            what `bench.py --mode lookahead` reports)
+          prefetch_patch_bytes_per_step the patch recompute's wire cost
+                            model at that bound: patched rows x (id wire
+                            + one activation slot at the bucket's float
+                            wire) — the EXTRA exchange traffic the
+                            overlap window adds on top of the normal
+                            (merely earlier) prefetched exchange
+
+        Both are 0 at lookahead=0 (and under `stale_ok`, which skips the
+        patch — the report models the bit-exact mode).
+
         Args:
           hotness: per-tp-input hotness override; defaults to the layer's
             input_max_hotness hints (unhinted inputs count as 1).
@@ -886,12 +911,15 @@ class DistributedEmbedding:
             (default 1 = per-sample accounting, matching the id fields).
           vocab: optional `vocab.VocabManager` supplying measured
             occupancy/eviction numbers for managed tables.
+          lookahead: pipeline depth for the prefetch-patch model (0 = the
+            sequential step, patch fields report 0).
         Returns {"groups": [...], "true_ids", "exchanged_ids", "ratio",
         "exchanged_bytes", "true_bytes", "act_bytes", "act_bytes_f32",
         "act_wire_reduction", "wire_dtypes", "id_narrowed_groups",
         "hot_hit_ids", "true_ids_post_hot", "hot_hit_rates",
         "touched_rows_per_step", "delta_bytes_per_step", "occupancy",
-        "slack_rows", "evictions_per_step"}.
+        "slack_rows", "evictions_per_step", "lookahead",
+        "prefetch_patch_rows_per_step", "prefetch_patch_bytes_per_step"}.
         """
         tp_inputs = self.strategy.input_groups[1]
         if hotness is None:
@@ -952,6 +980,7 @@ class DistributedEmbedding:
         groups, _ = self._exchange_groups_for_key(key)
         report, true_tot, ex_tot, hot_tot = [], 0, 0, 0
         touched_tot, delta_bytes_tot = 0, 0
+        patch_rows_tot, patch_bytes_tot = 0, 0
         ex_bytes_tot, true_bytes_tot = 0, 0
         act_bytes_tot, act_bytes_f32_tot = 0, 0
         id_narrowed = []
@@ -1026,6 +1055,16 @@ class DistributedEmbedding:
                 (touched + hot_pub) * (8 + 4 * bucket.width))
             touched_tot += touched
             delta_bytes_tot += entry["delta_bytes_per_step"]
+            # lookahead overlap-window model (ISSUE 9): worst case, every
+            # row the previous step touched reappears in the prefetched
+            # batch and is re-exchanged by the correctness patch — one id
+            # + one activation slot per patched row at this bucket's wire
+            patch_rows = touched if lookahead > 0 else 0
+            entry["prefetch_patch_rows_per_step"] = patch_rows
+            entry["prefetch_patch_bytes_per_step"] = (
+                patch_rows * (id_b + w_out * wire_b))
+            patch_rows_tot += patch_rows
+            patch_bytes_tot += entry["prefetch_patch_bytes_per_step"]
             report.append(entry)
         return {"groups": report, "true_ids": true_tot,
                 "exchanged_ids": ex_tot,
@@ -1047,6 +1086,9 @@ class DistributedEmbedding:
                 "hot_hit_rates": {b: rate_for(b) for b in self._hot_buckets},
                 "touched_rows_per_step": touched_tot,
                 "delta_bytes_per_step": delta_bytes_tot,
+                "lookahead": int(lookahead),
+                "prefetch_patch_rows_per_step": patch_rows_tot,
+                "prefetch_patch_bytes_per_step": patch_bytes_tot,
                 # capacity accounting (ISSUE 7), each bucket counted ONCE
                 # (a bucket can serve several hotness groups): occupancy
                 # capacity-weighted over buckets, slack/evictions summed
@@ -1934,7 +1976,8 @@ class DistributedEmbedding:
         return row_outs, (res_ids, res_w, res_sort)
 
     def apply(self, params: dict, inputs: Sequence, taps=None,
-              return_residuals: bool = False, residual_sort=None):
+              return_residuals: bool = False, residual_sort=None,
+              _want_exchange: bool = False):
         """Forward pass with data-parallel input.
 
         Args:
@@ -1953,6 +1996,16 @@ class DistributedEmbedding:
             and host-offload paths keep their exact pre-fold behavior);
             False forces off; an (optimizer_kind, strategy) tuple forces
             the spec. Only consulted when return_residuals is True.
+          _want_exchange: lookahead prefetch mode (ISSUE 9, used by
+            `schedule.LookaheadEngine`): return the RAW exchange-stage
+            artifacts `(ex_list, row_outs, residuals)` instead of
+            assembled per-input outputs — ex_list is the post-all_to_all
+            per-group activation block `[world_src, B, f_max_g, wf]`,
+            row_outs the post-psum_scatter row-table partials. The
+            exchange computation is the IDENTICAL code path the normal
+            forward runs (the dp lookup and assembly are traced but
+            unused, so XLA drops them); a later `staged_exchange_scope`
+            forward re-attaches these artifacts bit-exactly.
 
         Returns:
           One [B, width] array per input (or [B, k, width] for combiner=None
@@ -1962,6 +2015,15 @@ class DistributedEmbedding:
         if not self.dp_input:
             raise ValueError("This layer was built with dp_input=False; "
                              "use apply_mp() instead")
+        if self._staged_exchange is not None and not _want_exchange:
+            return self._apply_staged(params, inputs, taps=taps,
+                                      return_residuals=return_residuals)
+        if _want_exchange:
+            return_residuals = True
+            if taps is not None:
+                raise ValueError("_want_exchange is a tapless prefetch "
+                                 "mode; gradients reach the tables via "
+                                 "the drain-stage transpose, not taps")
         if residual_sort is None:
             sort_spec = self._residual_sort_spec
         else:
@@ -2113,6 +2175,21 @@ class DistributedEmbedding:
                     sort_plan=sort_plan, row_sort_plan=row_sort_plan,
                     hot_params=hot_params))
 
+        if _want_exchange:
+            # lookahead prefetch return (ISSUE 9): the raw exchange-stage
+            # artifacts. Offloaded buckets are refused — their lookup runs
+            # host-side OUTSIDE the jitted stage, so there is no device
+            # artifact to carry across the pipeline boundary.
+            if offloaded_groups:
+                raise NotImplementedError(
+                    "lookahead prefetch (_want_exchange) does not support "
+                    "host-offloaded buckets: their lookups run outside the "
+                    "jitted stage and cannot be carried/patched")
+            key = tuple((p.k, p.weights is not None) for p in tp_prep)
+            return ex_list, row_outs, TapResiduals(
+                key, res[0], res[1], res[2], res[3], res[4], res[5],
+                res[6], res[7])
+
         # offloaded buckets: host-side lookup + GSPMD exchange (or the
         # scoped serving override — see offload_lookup_scope)
         for g in offloaded_groups:
@@ -2173,6 +2250,255 @@ class DistributedEmbedding:
             tp_final.append(self._restore_shape(out, p, cfg.get("combiner"),
                                                 out.shape[-1]))
         return tp_final
+
+    # ------------------------------------------ lookahead staging (ISSUE 9)
+    @contextlib.contextmanager
+    def staged_exchange_scope(self, ex_list, row_outs):
+        """Scope forwards over PREFETCHED exchange artifacts.
+
+        Inside the scope, `apply(params, inputs)` skips the id exchange /
+        table gather / activation all_to_all and consumes the provided
+        per-group activation blocks (`ex_list`, from a prior
+        `apply(..., _want_exchange=True)`) and row-table partials
+        (`row_outs`) instead — only the dp lookups and the output
+        assembly run live. This is the dense stage of the lookahead
+        pipeline (schedule.LookaheadEngine): differentiating the scoped
+        forward w.r.t. `ex_list`/`row_outs` yields exactly the
+        activation cotangents whose explicit dp->mp transpose
+        (`exchange_transpose`) reproduces the monolithic step's tap
+        gradients bit-exactly."""
+        prev = self._staged_exchange
+        self._staged_exchange = (list(ex_list), list(row_outs))
+        try:
+            yield
+        finally:
+            self._staged_exchange = prev
+
+    def _apply_staged(self, params, inputs, taps=None,
+                      return_residuals=False):
+        """apply() body under `staged_exchange_scope`: live dp lookups +
+        assembly over the carried exchange artifacts (same code path the
+        stock forward's tail runs, so values are bit-identical given
+        bit-identical artifacts)."""
+        if taps is not None or return_residuals:
+            raise ValueError(
+                "staged_exchange_scope forwards are tapless by design — "
+                "table gradients reach the sparse update through the "
+                "engine's drain-stage transpose, not taps")
+        if self._hot_buckets:
+            raise NotImplementedError(
+                "staged_exchange_scope does not support hot-row "
+                "replicated buckets (the replicated hot shard updates "
+                "densely every step, so prefetched activations cannot be "
+                "patched from the touched-row set)")
+        prepped = self._prepare_inputs(inputs)
+        strat = self.strategy
+        batch = prepped[0].ids.shape[0]
+        dp_prep = [prepped[i] for i in strat.input_groups[0]]
+        tp_prep = [prepped[i] for i in strat.input_groups[1]]
+        row_prep = [prepped[i] for i in strat.input_groups[2]]
+        groups, assembly = ([], [])
+        if tp_prep:
+            groups, assembly = self._exchange_groups(tp_prep)
+        ex_list, row_outs = self._staged_exchange
+        if len(ex_list) != len(groups) or len(row_outs) != len(row_prep):
+            raise ValueError(
+                f"staged exchange artifacts do not match this batch's "
+                f"plan: got {len(ex_list)} group blocks / {len(row_outs)} "
+                f"row partials, expected {len(groups)} / {len(row_prep)}")
+        # dp lookups run live (dense-trained tables must see CURRENT
+        # params): replicated table + per-sample gather/combine — the
+        # identical math the shard_map body's dp section runs per shard
+        dp_outs = []
+        for j, p in enumerate(dp_prep):
+            t_dp = strat.map_groups[0][j]
+            cfg = strat.dp_configs[t_dp]
+            if self._dp_custom_layers.get(t_dp) is not None:
+                raise NotImplementedError(
+                    "staged_exchange_scope does not support custom "
+                    "embedding layer classes on dp tables (their forward "
+                    "is defined per-device under shard_map)")
+            rows = self._cast(jnp.take(params["dp"][t_dp], p.ids, axis=0))
+            dp_outs.append(_combine(rows, p.weights, cfg.get("combiner")))
+        dp_final = []
+        for j, out in enumerate(dp_outs):
+            cfg = strat.dp_configs[strat.map_groups[0][j]]
+            dp_final.append(self._restore_shape(out, dp_prep[j],
+                                                cfg.get("combiner"),
+                                                cfg["output_dim"]))
+        tp_final = self._assemble_tp_outputs(ex_list, tp_prep, batch,
+                                             groups, assembly)
+        row_final = []
+        for j, out in enumerate(row_outs):
+            rt = self.plan.row_tables[strat.map_groups[2][j]]
+            row_final.append(self._restore_shape(out, row_prep[j],
+                                                 rt.combiner, rt.width))
+        outputs = dp_final + tp_final + row_final
+        return [outputs[idx] for idx in strat.rev_group_ids]
+
+    def exchange_transpose(self, g_ex, g_row, key) -> dict:
+        """Drain-stage gradient transpose (ISSUE 9): move the dense
+        stage's activation cotangents dp->mp, producing the exact
+        `make_taps`-shaped gradient pytree `sparse_update` consumes.
+
+        In the monolithic step this movement happens inside autodiff (the
+        custom-vjp backward of the forward exchange); in the lookahead
+        pipeline the forward exchange ran one step earlier in a different
+        traced region, so the transpose is invoked explicitly — via
+        `ops.wire.wire_all_to_all_t` / `wire_psum_scatter_t`, the same
+        bwd rules, which is what keeps lookahead=1 bit-exact.
+
+        Args:
+          g_ex: per exchange group, cotangent of the carried activation
+            block [world_src, B, f_max_g, wf].
+          g_row: per row-sliced input, cotangent of the carried partial
+            [B, (k,) w].
+          key: the carried TapResiduals.key (selects the group layout).
+
+        Returns {"tp": [[world, B, f, w] ...], "row": [[world, B, ...]]}.
+        """
+        groups, _ = self._exchange_groups_for_key(key)
+        if len(g_ex) != len(groups):
+            raise ValueError(f"got {len(g_ex)} group cotangents, plan has "
+                             f"{len(groups)} exchange groups")
+        wires = [self.plan.tp_buckets[grp.bucket].wire_dtype
+                 for grp in groups]
+        row_wires = [self.plan.row_tables[t].wire_dtype
+                     for t in self.strategy.map_groups[2]]
+        world = self.world_size
+        if world == 1:
+            # forward: ex = out[None]; row partials pass through — the
+            # transpose is a leading-axis relabel
+            return {"tp": list(g_ex), "row": [g[None] for g in g_row]}
+
+        def body(g_ex_l, g_row_l):
+            tp_taps = []
+            for g, ge in enumerate(g_ex_l):       # [world_src, B_l, f, w]
+                h = wire_ops.wire_all_to_all_t(ge, self.axis, wires[g])
+                tp_taps.append(h.reshape((h.shape[0] * h.shape[1],)
+                                         + h.shape[2:])[None])
+            row_taps = []
+            for j, gr in enumerate(g_row_l):      # [B_l, (k,) w]
+                h = wire_ops.wire_psum_scatter_t(gr, self.axis,
+                                                 row_wires[j], world)
+                row_taps.append(h[None])
+            return tp_taps, row_taps
+
+        tp_taps, row_taps = compat.shard_map(
+            body, mesh=self.mesh,
+            in_specs=([P(None, self.axis)] * len(g_ex),
+                      [P(self.axis)] * len(g_row)),
+            out_specs=([P(self.axis)] * len(g_ex),
+                       [P(self.axis)] * len(g_row)),
+            check_vma=False,
+        )(list(g_ex), list(g_row))
+        return {"tp": tp_taps, "row": row_taps}
+
+    def patch_staged_carry(self, ex_list, row_outs, patch_ex, patch_row,
+                           patch_idx, batch: int):
+        """Overwrite the carried exchange artifacts for the patched
+        samples (ISSUE 9): sample `patch_idx[i]` of the carry takes the
+        freshly re-exchanged values at patch position i. Out-of-range
+        indices (the padding convention: index == batch) drop.
+
+        The scatter runs per shard (each device patches only the rows of
+        its own batch slice) so the batch-sharded carry never regathers.
+        """
+        if self.world_size == 1:
+            ex = [e.at[:, patch_idx].set(pe, mode="drop")
+                  for e, pe in zip(ex_list, patch_ex)]
+            row = [r.at[patch_idx].set(pr, mode="drop")
+                   for r, pr in zip(row_outs, patch_row)]
+            return ex, row
+        blocal = batch // self.world_size
+
+        def body(ex_l, row_l, pex_l, prow_l, idx):
+            rank = lax.axis_index(self.axis)
+            lidx = idx.astype(jnp.int32) - rank * jnp.int32(blocal)
+            # foreign-shard and padding rows land on the OOB slot -> drop
+            lidx = jnp.where((lidx >= 0) & (lidx < blocal), lidx,
+                             jnp.int32(blocal))
+            ex2 = [e.at[:, lidx].set(pe, mode="drop")
+                   for e, pe in zip(ex_l, pex_l)]
+            row2 = [r.at[lidx].set(pr, mode="drop")
+                    for r, pr in zip(row_l, prow_l)]
+            return ex2, row2
+
+        return compat.shard_map(
+            body, mesh=self.mesh,
+            in_specs=([P(None, self.axis)] * len(ex_list),
+                      [P(self.axis)] * len(row_outs),
+                      # patch blocks replicate: every shard sees every
+                      # patched sample and keeps only its own rows
+                      [P()] * len(ex_list), [P()] * len(row_outs), P()),
+            out_specs=([P(None, self.axis)] * len(ex_list),
+                       [P(self.axis)] * len(row_outs)),
+            check_vma=False,
+        )(list(ex_list), list(row_outs), list(patch_ex), list(patch_row),
+          patch_idx)
+
+    def prefetch_stale_mask(self, inputs, touched) -> np.ndarray:
+        """Host-side [B] bool mask: which samples of a PREFETCHED batch
+        contain at least one id whose row the previous batch's sparse
+        update touched (`touched` = that batch's `touched_row_keys`) —
+        i.e. which prefetched activations are stale and must be patched
+        against the post-update tables (ISSUE 9).
+
+        Same key-space walk as `touched_row_keys`, kept per-sample:
+        tp ids map to ``rank * rows_max + row_offset + id`` flat keys,
+        row-sliced ids are global rows; out-of-range ids are
+        sentinel-dropped by the update and never match. Dense/(ids,
+        weights) input forms only (the engine refuses ragged/sparse
+        inputs — their per-sample selection would be shape-dynamic)."""
+        if len(inputs) != self._n_inputs:
+            raise ValueError(
+                f"Expected {self._n_inputs} inputs, got {len(inputs)}")
+
+        def host_2d(x):
+            if (isinstance(x, tuple) and len(x) == 2
+                    and not isinstance(x, RaggedIds)):
+                x = x[0]
+            if isinstance(x, (RaggedIds, SparseIds)):
+                raise NotImplementedError(
+                    "prefetch_stale_mask supports dense id inputs only")
+            a = np.asarray(jax.device_get(x)).astype(np.int64)
+            return a.reshape(a.shape[0], -1)
+
+        seg_rows = {(pl.bucket, pl.rank, pl.row_offset): pl.rows
+                    for pl in self.plan.tp_placements}
+        mask = None
+        for pos, i in enumerate(self.strategy.input_groups[1]):
+            ids = host_2d(inputs[i])
+            if mask is None:
+                mask = np.zeros(ids.shape[0], bool)
+            for (rank, b, slot_idx) in self.plan.tp_input_slots[pos]:
+                t = touched.get(("tp", b))
+                if t is None or not len(t):
+                    continue
+                bucket = self.plan.tp_buckets[b]
+                off = bucket.slots[rank][slot_idx].row_offset
+                rows = seg_rows.get((b, rank, off), 0)
+                valid = (ids >= 0) & (ids < rows)
+                keys = rank * max(bucket.rows_max, 1) + off + ids
+                mask |= (valid & np.isin(keys, t)).any(axis=1)
+        for j, i in enumerate(self.strategy.input_groups[2]):
+            t_id = self.strategy.map_groups[2][j]
+            t = touched.get(("row", t_id))
+            ids = host_2d(inputs[i])
+            if mask is None:
+                mask = np.zeros(ids.shape[0], bool)
+            if t is None or not len(t):
+                continue
+            total = int(sum(self.plan.row_tables[t_id].rows_per_rank))
+            valid = (ids >= 0) & (ids < total)
+            mask |= (valid & np.isin(ids, t)).any(axis=1)
+        if mask is None:
+            # no mp inputs at all — nothing prefetched, nothing stale
+            x = inputs[0]
+            n = (np.asarray(x[0]).shape[0] if isinstance(x, tuple)
+                 else np.asarray(x).shape[0])
+            mask = np.zeros(n, bool)
+        return mask
 
     def apply_mp(self, params: dict, inputs, taps=None,
                  return_residuals: bool = False, residual_sort=None):

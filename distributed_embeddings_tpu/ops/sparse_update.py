@@ -902,3 +902,33 @@ def make_sparse_optimizer(kind: str, lr, strategy: str = "auto",
             return t, (mu, nu, c)
         return SparseOptimizer("adam", init, update, lr, hp_t, strategy)
     raise ValueError(f"Unknown sparse optimizer {kind!r}")
+
+
+def drain_sparse_apply(emb, params_emb, state_emb, tap_grads, residuals,
+                       opt, off_buckets=()):
+    """Drain-stage entry (ISSUE 9): apply one batch's tap gradients to the
+    embedding tables — the tail every train-step variant shares.
+
+    Two producers feed it: the monolithic `make_sparse_train_step`, where
+    autodiff delivered `tap_grads` (the backward already ran the dp->mp
+    gradient transpose inside the custom-vjp exchange), and the lookahead
+    pipeline (`schedule.LookaheadEngine`), where the engine's explicit
+    `DistributedEmbedding.exchange_transpose` did. Both hand the exact
+    `make_taps`-shaped pytree; the update itself is the layer's
+    `sparse_update`.
+
+    `off_buckets` slots of the RETURNED pytrees are zeroed out: host-
+    resident leaves must never be jit outputs (XLA:CPU SPMD cannot place
+    them; TPU would copy them device-ward) — the caller replaces those
+    slots with the out-of-jit host-apply results, driven by the returned
+    `pending` dict (see `make_sparse_train_step`).
+
+    Returns (new_params_emb, new_state_emb, pending).
+    """
+    new_emb, new_state, pending = emb.sparse_update(
+        params_emb, state_emb, tap_grads, residuals, opt)
+    for b in off_buckets:
+        new_emb["tp"][b] = jnp.zeros((0,), jnp.float32)
+        new_state["tp"][b] = jax.tree.map(
+            lambda _: jnp.zeros((0,), jnp.float32), new_state["tp"][b])
+    return new_emb, new_state, pending

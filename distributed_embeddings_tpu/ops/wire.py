@@ -74,6 +74,8 @@ __all__ = [
     "wire_all_to_all",
     "wire_all_gather",
     "wire_psum_scatter",
+    "wire_all_to_all_t",
+    "wire_psum_scatter_t",
 ]
 
 WIRE_FORMATS = ("f32", "bf16", "bf16-sr")
@@ -307,3 +309,36 @@ def wire_psum_scatter(x: jax.Array, axis: str, wire: str,
     if wire == "f32":
         return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
     return _wired_psum_scatter(axis, wire, x.dtype.name, world)(x)
+
+
+# ------------------------------------------------- explicit transposes
+# The lookahead drain stage (ISSUE 9, schedule/lookahead.py) moves the
+# dense stage's activation cotangents dp->mp OUTSIDE autodiff: the
+# forward exchange ran one step earlier in the prefetch stage, in a
+# different traced region, so the gradient transpose must be invoked
+# explicitly. These are the exact bwd rules of the custom_vjp wrappers
+# above, exported as plain functions — 'f32' lowers to the identical
+# lax collective JAX's own transpose rules emit for the monolithic
+# step, which is what makes lookahead=1 bit-exact against it.
+
+def wire_all_to_all_t(g: jax.Array, axis: str, wire: str) -> jax.Array:
+    """Transpose of `wire_all_to_all`: the split0/concat0 all_to_all is
+    its own transpose, over the GRADIENT wire encoding."""
+    wire = resolve_wire(wire)
+    if wire == "f32":
+        return lax.all_to_all(g, axis, split_axis=0, concat_axis=0)
+    y = lax.all_to_all(encode_bwd(g, wire), axis,
+                       split_axis=0, concat_axis=0)
+    return y.astype(g.dtype)
+
+
+def wire_psum_scatter_t(g: jax.Array, axis: str, wire: str,
+                        world: int) -> jax.Array:
+    """Transpose of `wire_psum_scatter`: a tiled all_gather of the
+    wire-encoded gradient (the reduce-scatter's transpose)."""
+    del world  # kept for signature symmetry with wire_psum_scatter
+    wire = resolve_wire(wire)
+    if wire == "f32":
+        return lax.all_gather(g, axis, axis=0, tiled=True)
+    h = lax.all_gather(encode_bwd(g, wire), axis, axis=0, tiled=True)
+    return h.astype(g.dtype)

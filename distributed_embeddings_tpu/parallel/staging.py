@@ -16,7 +16,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_embeddings_tpu.parallel.mesh import DEFAULT_AXIS
 
-__all__ = ["stage_dp_batch", "stage_replicated"]
+__all__ = ["stage_dp_batch", "stage_replicated", "DoubleBufferSlots"]
 
 
 def stage_dp_batch(mesh: Mesh, batch: Any,
@@ -49,3 +49,59 @@ def stage_replicated(mesh: Mesh, tree: Any) -> Any:
     sharding = NamedSharding(mesh, P())
     return jax.tree.map(lambda x: jax.device_put(np.asarray(x), sharding),
                         tree)
+
+
+class DoubleBufferSlots:
+    """Two-slot device carry for the lookahead pipeline (ISSUE 9).
+
+    The `schedule.LookaheadEngine` keeps one batch's prefetched exchange
+    artifacts on device while the fused step produces the next batch's —
+    a classic double buffer. This helper owns the slot discipline:
+
+      * `stage(tree, tag)` installs a freshly produced carry (and returns
+        the evicted one, if any — with step donation on, that pytree's
+        buffers were CONSUMED by the producing call and must not be
+        touched again; holding it only here makes accidental host reuse
+        structurally visible).
+      * `current` / `tag` read the live slot; `take()` pops it for the
+        consuming call (the donation hand-off point).
+      * `clear()` invalidates both slots (pipeline flush — e.g. params
+        were rewritten outside the engine and every prefetch is stale).
+
+    Tags are opaque identities (the engine uses the upcoming batch
+    object) so a consumer can verify the staged carry belongs to the
+    batch it is about to run.
+    """
+
+    def __init__(self):
+        self._live = None        # (tag, tree)
+        self._retired = None     # previous (tag, tree), donation-dead
+
+    def stage(self, tree: Any, tag: Any = None) -> Optional[Any]:
+        """Install `tree` as the live carry; returns the evicted tree."""
+        evicted = self._retired[1] if self._retired is not None else None
+        self._retired = self._live
+        self._live = (tag, tree)
+        return evicted
+
+    @property
+    def current(self) -> Optional[Any]:
+        return self._live[1] if self._live is not None else None
+
+    @property
+    def tag(self) -> Optional[Any]:
+        return self._live[0] if self._live is not None else None
+
+    def take(self) -> Optional[Any]:
+        """Pop the live carry for consumption (it moves to the retired
+        slot: its buffers may be donated by the consuming call)."""
+        if self._live is None:
+            return None
+        tag_tree = self._live
+        self._retired = tag_tree
+        self._live = None
+        return tag_tree[1]
+
+    def clear(self) -> None:
+        self._live = None
+        self._retired = None

@@ -1,0 +1,13 @@
+"""Device-side execution scheduling (ISSUE 9).
+
+The fourth subsystem, alongside `serving/`, `store/` and `vocab/`: where
+those manage the *state* of the embedding system (queries, versions,
+bindings), `schedule/` manages the *shape of a training step in time* —
+restructuring the monolithic jitted step into an explicit multi-stage
+device pipeline whose exchange collectives overlap the dense compute.
+"""
+
+from distributed_embeddings_tpu.schedule.lookahead import (
+    LookaheadEngine, default_lookahead)
+
+__all__ = ["LookaheadEngine", "default_lookahead"]

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from distributed_embeddings_tpu.layers.dist_model_parallel import (
     broadcast_variables)
 from distributed_embeddings_tpu.ops.sparse_update import (
-    make_sparse_optimizer)
+    drain_sparse_apply, make_sparse_optimizer, prevalidate_active_impl)
 
 __all__ = [
     "DistributedGradientTape",
@@ -181,6 +181,49 @@ def _merge_dense(dense, params):
     return out
 
 
+def _sparse_optimizer_setup(optimizer: str, lr, strategy: str,
+                            dense_optimizer):
+    """Sparse + dense optimizer construction shared by the monolithic
+    step (`make_sparse_train_step`) and the lookahead engine
+    (`schedule.LookaheadEngine`) — ONE home for the eps parity
+    constants, the kernel prevalidation, and the scheduled-lr per-step
+    rebuild rule; the engine's bit-exact-vs-monolithic contract depends
+    on these matching exactly.
+
+    Returns ``(scheduled, sopt_for, dense_optimizer)``:
+    ``sopt_for(None)`` is the static optimizer (lr 0.0 under a
+    schedule); ``sopt_for(opt_state)`` rebuilds it at
+    ``lr(opt_state["count"])`` inside the traced step when `lr` is a
+    schedule callable, and returns the static one otherwise."""
+    import optax
+
+    # eps matches optax's adagrad so dp tables and tp/row tables see the
+    # same rule (reference: one Keras optimizer instance for the whole
+    # model)
+    sparse_hp = {"adagrad": {"eps": 1e-7}, "adam": {}, "sgd": {}}[optimizer]
+    scheduled = callable(lr)
+    # eagerly validate any DET_SCATTER_IMPL kernel choice on the attached
+    # chip now — inside the traced step only the cached verdict is
+    # consulted, so without this call the env knob would be silently inert
+    prevalidate_active_impl(strategy=strategy)
+    sopt = make_sparse_optimizer(optimizer, 0.0 if scheduled else lr,
+                                 strategy=strategy, **sparse_hp)
+    if dense_optimizer is None:
+        dense_optimizer = {
+            "sgd": lambda: optax.sgd(lr),
+            "adagrad": lambda: optax.adagrad(lr),
+            "adam": lambda: optax.adam(lr),
+        }[optimizer]()
+
+    def sopt_for(opt_state=None):
+        if not scheduled or opt_state is None:
+            return sopt
+        return make_sparse_optimizer(optimizer, lr(opt_state["count"]),
+                                     strategy=strategy, **sparse_hp)
+
+    return scheduled, sopt_for, dense_optimizer
+
+
 def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
                            dense_optimizer=None, strategy: str = "auto",
                            donate: Optional[bool] = None,
@@ -224,27 +267,10 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
       step_fn(params, opt_state, numerical, cats, labels)
         -> (params, opt_state, loss);  jit with donated params/opt_state.
     """
-    import optax
-
     emb = model.embedding
-    # eps matches optax's adagrad so dp tables and tp/row tables see the
-    # same rule (reference: one Keras optimizer instance for the whole model)
-    sparse_hp = {"adagrad": {"eps": 1e-7}, "adam": {}, "sgd": {}}[optimizer]
-    scheduled = callable(lr)
-    # eagerly validate any DET_SCATTER_IMPL kernel choice on the attached
-    # chip now — inside the traced step only the cached verdict is
-    # consulted, so without this call the env knob would be silently inert
-    from distributed_embeddings_tpu.ops.sparse_update import (
-        prevalidate_active_impl)
-    prevalidate_active_impl(strategy=strategy)
-    sopt = make_sparse_optimizer(optimizer, 0.0 if scheduled else lr,
-                                 strategy=strategy, **sparse_hp)
-    if dense_optimizer is None:
-        dense_optimizer = {
-            "sgd": lambda: optax.sgd(lr),
-            "adagrad": lambda: optax.adagrad(lr),
-            "adam": lambda: optax.adam(lr),
-        }[optimizer]()
+    scheduled, sopt_for, dense_optimizer = _sparse_optimizer_setup(
+        optimizer, lr, strategy, dense_optimizer)
+    sopt = sopt_for()
 
     def init_fn(params):
         state = {"emb": emb.init_sparse_state(params["embedding"], sopt),
@@ -260,12 +286,7 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
     def step_fn(params, opt_state, numerical, cats, labels):
         cats = list(cats)
         taps = emb.make_taps(cats)
-        if scheduled:
-            sopt_t = make_sparse_optimizer(
-                optimizer, lr(opt_state["count"]), strategy=strategy,
-                **sparse_hp)
-        else:
-            sopt_t = sopt
+        sopt_t = sopt_for(opt_state)
 
         def loss_with_taps(dense, taps):
             p = _merge_dense(dense, params)
@@ -279,15 +300,11 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
         with emb.residual_sort_scope(sort_spec):
             (loss, res), (g_dense, g_taps) = jax.value_and_grad(
                 loss_with_taps, argnums=(0, 1), has_aux=True)(dense0, taps)
-        new_emb, new_emb_state, pending = emb.sparse_update(
-            params["embedding"], opt_state["emb"], g_taps, res, sopt_t)
-        # never emit host-resident leaves as jit outputs (XLA:CPU SPMD cannot
-        # place them; TPU would copy them device-ward): off-bucket slots are
-        # replaced by the caller with the host-apply results
-        for b in off_buckets:
-            new_emb["tp"][b] = jnp.zeros((0,), jnp.float32)
-            new_emb_state["tp"][b] = jax.tree.map(
-                lambda _: jnp.zeros((0,), jnp.float32), new_emb_state["tp"][b])
+        # the shared drain-stage tail (also the lookahead engine's): sparse
+        # update + off-bucket output zeroing (host leaves never leave jit)
+        new_emb, new_emb_state, pending = drain_sparse_apply(
+            emb, params["embedding"], opt_state["emb"], g_taps, res, sopt_t,
+            off_buckets)
         updates, new_dense_state = dense_optimizer.update(
             g_dense, opt_state["dense"], dense0)
         new_dense = apply_updates(dense0, updates)
@@ -346,7 +363,8 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         stage=None, sync_every=None, preprocess=None, pipelined: bool = True,
         pipeline_depth: int = 2, hot_sync_every: int = 0,
         store=None, publish_every: int = 0, publish_dir=None,
-        vocab=None, vocab_every: int = 16):
+        vocab=None, vocab_every: int = 16,
+        lookahead=None, stale_ok: bool = False):
     """Minimal training-loop driver — the role the reference fills with
     Keras `model.fit` + `DistributedOptimizer` + callbacks
     (reference dist_model_parallel.py:1270-1326, synthetic main.py:104-114).
@@ -418,6 +436,25 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         binding state is published as a ``vocab_v{version}.npz``
         sidecar consumers (`InferenceEngine.poll_updates`) load
         alongside the rows. History gains 'vocab_stats'.
+      lookahead / stale_ok: device-pipeline depth (ISSUE 9, sparse path
+        only). ``lookahead=1`` runs training through a
+        `schedule.LookaheadEngine`: batch N+1's id exchange, table
+        gather and activation all_to_all are issued in the same fused
+        device program as batch N's dense forward/backward (no data
+        dependency between them — auditable, see tools/hlo_audit.py's
+        overlap arm), with the gradient transpose + sparse update
+        trailing as the drain stage. Bit-exact against lookahead=0 by
+        default (the engine patches prefetched activations for rows the
+        previous step touched); ``stale_ok=True`` skips the patch with
+        documented one-step-stale semantics (docs/userguide.md).
+        ``lookahead=None`` reads ``DET_LOOKAHEAD`` (default 0).
+        Refused compositions (loudly, here at fit time): the dense
+        (sparse=False) path, hot-row replication (`hot_sync_every` /
+        hot-sharded layers), and a `VocabManager` with maintenance
+        cycles (``vocab_every != 0``) — a mid-window evict+rebind would
+        invalidate already-prefetched physical rows. Translate-only
+        vocab use (``vocab_every=0``) composes: batches are translated
+        when PULLED, before the engine prefetches them.
       hot_sync_every: hot-row replication cadence (layers built with
         `hot_rows=`, sparse path only): every N steps the loop runs
         `sync_hot_rows(admit=True)` — write hot rows back to the
@@ -435,7 +472,38 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
     ('loss' as floats, drained from device at sync/log boundaries;
     optionally 'eval_auc').
     """
-    if sparse:
+    if lookahead is None:
+        from distributed_embeddings_tpu.schedule import default_lookahead
+        lookahead = default_lookahead()
+    la_engine = None
+    if lookahead:
+        # unsupported compositions are refused HERE, loudly, not degraded:
+        if not sparse:
+            raise ValueError(
+                "lookahead requires the sparse tapped path (sparse=True)")
+        if hot_sync_every or getattr(getattr(model, "embedding", None),
+                                     "_hot_buckets", None):
+            raise NotImplementedError(
+                "lookahead>0 does not compose with hot-row replication: "
+                "the replicated hot shard moves densely every step, so "
+                "prefetched activations cannot be patched from the "
+                "touched-row set (at most one of hot_rows / lookahead "
+                "per run, mirroring the hot-rows x vocab refusal)")
+        if vocab is not None and vocab_every:
+            raise NotImplementedError(
+                "lookahead>0 does not compose with VocabManager "
+                "maintenance cycles (vocab_every != 0): a same-window "
+                "evict+rebind would invalidate physical rows the engine "
+                "already prefetched — run with vocab_every=0 "
+                "(translate-only) or lookahead=0")
+        from distributed_embeddings_tpu.schedule import LookaheadEngine
+        la_engine = LookaheadEngine(
+            model, optimizer, lr=lr, dense_optimizer=dense_optimizer,
+            lookahead=lookahead, stale_ok=stale_ok)
+        step_fn = None
+        if opt_state is None:
+            opt_state = la_engine.init(params)
+    elif sparse:
         init_fn, step_fn = make_sparse_train_step(
             model, optimizer, lr=lr, dense_optimizer=dense_optimizer)
         if opt_state is None:
@@ -537,11 +605,29 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
                              full=False)
         history.setdefault("published", []).append(store.publish(publish_dir))
 
+    def pull(s):
+        b = get_batch(s) if get_batch else next(it)
+        if la_engine is not None and vocab is not None:
+            # translate at PULL time under lookahead: the engine
+            # prefetches this batch's exchange before the loop body
+            # consumes it, so raw->physical translation must happen
+            # first. Maintenance is refused with lookahead, so the
+            # binding the early translation sees is the same one the
+            # consume step would.
+            n, c, lbl = b
+            b = (n, vocab.translate(list(c), observe=True), lbl)
+        return b
+
+    next_batch = None
     try:
         for step in range(steps):
-            batch = get_batch(step) if get_batch else next(it)
+            if la_engine is not None:
+                batch = next_batch if next_batch is not None else pull(step)
+                next_batch = pull(step + 1) if step + 1 < steps else None
+            else:
+                batch = pull(step)
             numerical, cats, labels = batch
-            if vocab is not None:
+            if vocab is not None and la_engine is None:
                 # maintain BEFORE translating this batch: a maintain
                 # cycle can evict key K and immediately rebind K's freed
                 # row to a fresh key — a batch translated before the
@@ -573,10 +659,13 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
                         params["embedding"], opt_state["emb"], admit=True)
                     params = {**params, "embedding": p_emb}
                     opt_state = {**opt_state, "emb": s_emb}
-            params, opt_state, loss = step_fn(params, opt_state,
-                                              jnp.asarray(numerical),
-                                              [jnp.asarray(c) for c in cats],
-                                              jnp.asarray(labels))
+            if la_engine is not None:
+                params, opt_state, loss = la_engine.step(
+                    params, opt_state, batch, next_batch)
+            else:
+                params, opt_state, loss = step_fn(
+                    params, opt_state, jnp.asarray(numerical),
+                    [jnp.asarray(c) for c in cats], jnp.asarray(labels))
             pending.append(loss)
             if publishing:
                 steps_since_publish += 1
@@ -605,6 +694,8 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
             history["ingest_stages"] = pipeline.stage_summaries()
             pipeline.close()
     drain()
+    if la_engine is not None:
+        history["lookahead_stats"] = dict(la_engine.stats)
     if hot_active:
         # leave the returned params canonical-consistent (hot rows written
         # back; residency unchanged) so raw-param consumers need no extra

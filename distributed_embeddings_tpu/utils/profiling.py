@@ -21,7 +21,7 @@ import jax
 
 __all__ = ["BenchResult", "benchmark", "benchmark_batches", "trace",
            "annotate", "fetch_sync", "hlo_op_counts",
-           "hlo_collective_bytes"]
+           "hlo_collective_bytes", "hlo_collective_overlap"]
 
 
 def hlo_op_counts(lowered, ops: Sequence[str] = ("sort", "scatter", "gather",
@@ -106,6 +106,195 @@ def hlo_collective_bytes(lowered, collectives=_COLLECTIVES) -> dict:
     out["float_bytes"] = float_b
     out["int_bytes"] = int_b
     return out
+
+
+def hlo_collective_overlap(lowered, collectives=_COLLECTIVES,
+                           compute_ops=("dot_general",
+                                        "convolution")) -> dict:
+    """Classify every collective in a lowered program by its dependency
+    relation to the module's dense compute — the static overlap audit
+    behind the lookahead pipeline (ISSUE 9, docs/perf_model.md
+    "Lookahead prefetch").
+
+    A collective with dense compute (dot_general/convolution) in NEITHER
+    its transitive fan-in NOR its transitive fan-out is an **overlap
+    candidate**: no data dependency orders it against the dense stage,
+    so XLA's latency-hiding scheduler is free to run it concurrently
+    with the MXU work (async collective start/done pairs). In the
+    monolithic sequential step every exchange collective fails this test
+    — the forward exchange FEEDS the dense ops and the gradient
+    transpose CONSUMES them — so `overlap_candidates` is 0 there, while
+    the fused lookahead step's prefetch subgraph (batch N+1's exchange,
+    reading only params and the next batch's ids) passes it. That is
+    checkable at trace time on any backend, which makes it both the CI
+    regression gate for the pipeline structure and the attribution
+    artifact for TPU timing (tools/hlo_audit.py).
+
+    Method: the StableHLO SSA text is parsed into a per-function
+    dataflow graph; private helper functions (jax lowers shard_map
+    bodies and jnp helpers to `call @fn` sites) are summarized
+    transitively — a call-site inherits its callee's collective counts
+    and compute content — and the public entry function's graph is
+    taint-propagated in both directions. Granularity is the call SITE,
+    so a helper shared by the prefetch and drain stages is classified
+    per use, not once globally. Conservative where imprecise: a callee
+    mixing compute and collectives taints the whole call site, and
+    instructions inside nested REGIONS (stablehlo.while / case bodies,
+    e.g. a scanned multi-step program) fold into the enclosing op's
+    node — in both cases the mixed node's collectives count as
+    serialized, never as candidates.
+
+    Args:
+      lowered: ``jax.jit(f).lower(...)`` result or its ``.as_text()``.
+      collectives / compute_ops: StableHLO op mnemonics.
+
+    Returns {"collectives_total", "overlap_candidates",
+    "serialized_collectives", "candidates_by_op", "compute_sites"}.
+    """
+    import re
+    text = lowered if isinstance(lowered, str) else lowered.as_text()
+    line_re = re.compile(r'^\s*(%[\w]+)(?::\d+)?\s*=\s*(.*)$')
+    op_re = re.compile(r'"?(?:stablehlo|mhlo|chlo)\.([\w.]+)"?')
+    call_re = re.compile(r'(?:func\.)?call\s+@([\w$.-]+)')
+    func_re = re.compile(r'func\.func\s+(?:public\s+|private\s+)?'
+                         r'@([\w$.-]+)')
+
+    # Each node is one TOP-LEVEL instruction of a function. Instructions
+    # inside nested regions (stablehlo.while/case bodies) reference
+    # region block args a flat SSA graph cannot resolve, so their op
+    # kinds and operand refs FOLD INTO the enclosing op's node —
+    # conservative in the safe direction: a region mixing collectives
+    # and compute taints one node, and its collectives count as
+    # serialized, never as overlap candidates.
+    funcs: dict = {}
+    cur = None
+    depth = 0
+    for raw in text.splitlines():
+        fm = func_re.search(raw)
+        if fm:
+            cur = fm.group(1)
+            funcs[cur] = []
+            # the signature line's opening brace is the body baseline
+            depth = raw.count("{") - raw.count("}")
+            continue
+        if cur is None:
+            continue
+        at_top = depth <= 1
+        depth += raw.count("{") - raw.count("}")
+        m = line_re.match(raw)
+        if not m:
+            continue
+        lhs, rhs = m.group(1), m.group(2)
+        callee_m = call_re.search(rhs)
+        callee = callee_m.group(1) if callee_m else None
+        op_m = op_re.search(rhs)
+        op = op_m.group(1) if op_m else (
+            "call" if callee else rhs.split("(")[0].split()[0])
+        # operand refs: %N and %argN tokens on the rhs, multi-result
+        # projections (%5#1) resolve to their base value
+        operands = [t.split("#")[0] for t in
+                    re.findall(r'%[A-Za-z0-9_]+', rhs)]
+        if at_top or not funcs[cur]:
+            funcs[cur].append({"lhs": lhs, "ops": [op],
+                               "callees": [callee] if callee else [],
+                               "operands": operands})
+        else:
+            owner = funcs[cur][-1]
+            owner["ops"].append(op)
+            if callee:
+                owner["callees"].append(callee)
+            owner["operands"].extend(operands)
+
+    # ---- transitive per-function summaries (call graph is acyclic)
+    summaries: dict = {}
+
+    def summarize(fn, stack=()):
+        if fn in summaries:
+            return summaries[fn]
+        if fn not in funcs or fn in stack:
+            return {"coll": {}, "compute": False}
+        coll: dict = {}
+        compute = False
+        for node in funcs[fn]:
+            for op in node["ops"]:
+                if op in collectives:
+                    coll[op] = coll.get(op, 0) + 1
+                if op in compute_ops:
+                    compute = True
+            for callee in node["callees"]:
+                sub = summarize(callee, stack + (fn,))
+                compute = compute or sub["compute"]
+                for k, v in sub["coll"].items():
+                    coll[k] = coll.get(k, 0) + v
+        summaries[fn] = {"coll": coll, "compute": compute}
+        return summaries[fn]
+
+    entry = "main" if "main" in funcs else (
+        max(funcs, key=lambda f: len(funcs[f])) if funcs else None)
+    if entry is None:
+        return {"collectives_total": 0, "overlap_candidates": 0,
+                "serialized_collectives": 0, "candidates_by_op": {},
+                "compute_sites": 0}
+    body = funcs[entry]
+    n = len(body)
+    producer = {}
+    for i, node in enumerate(body):
+        producer[node["lhs"]] = i
+    deps = [[producer[o] for o in node["operands"] if o in producer]
+            for node in body]
+    node_coll = []
+    node_compute = []
+    for node in body:
+        c: dict = {}
+        compute = False
+        for op in node["ops"]:
+            if op in collectives:
+                c[op] = c.get(op, 0) + 1
+            if op in compute_ops:
+                compute = True
+        for callee in node["callees"]:
+            sub = summarize(callee)
+            compute = compute or sub["compute"]
+            for k, v in sub["coll"].items():
+                c[k] = c.get(k, 0) + v
+        node_coll.append(c)
+        node_compute.append(compute)
+
+    # SSA text order is topological: one forward pass taints fan-ins,
+    # one reverse pass taints fan-outs
+    dot_in_fanin = [False] * n
+    for i in range(n):
+        dot_in_fanin[i] = any(node_compute[d] or dot_in_fanin[d]
+                              for d in deps[i])
+    consumers: list = [[] for _ in range(n)]
+    for i, ds in enumerate(deps):
+        for d in ds:
+            consumers[d].append(i)
+    dot_in_fanout = [False] * n
+    for i in range(n - 1, -1, -1):
+        dot_in_fanout[i] = any(node_compute[c] or dot_in_fanout[c]
+                               for c in consumers[i])
+
+    total = 0
+    cand_by_op: dict = {}
+    candidates = 0
+    for i in range(n):
+        cnt = sum(node_coll[i].values())
+        if not cnt:
+            continue
+        total += cnt
+        # a site that itself CONTAINS compute is never a candidate (the
+        # collective may order against its own callee's dots)
+        if (not dot_in_fanin[i] and not dot_in_fanout[i]
+                and not node_compute[i]):
+            candidates += cnt
+            for k, v in node_coll[i].items():
+                cand_by_op[k] = cand_by_op.get(k, 0) + v
+    return {"collectives_total": total,
+            "overlap_candidates": candidates,
+            "serialized_collectives": total - candidates,
+            "candidates_by_op": cand_by_op,
+            "compute_sites": sum(node_compute)}
 
 
 def fetch_sync(out) -> float:

@@ -76,6 +76,7 @@ def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
                       seed=0, check_train=True, input_max_hotness=None,
                       rtol=1e-5, atol=1e-5, train_rtol=1e-4, train_atol=1e-5,
                       store_roundtrip=False, vocab_axis=False,
+                      lookahead_axis=False,
                       **dist_kwargs):
     """specs: list of (vocab, width) or (vocab, width, combiner).
 
@@ -83,6 +84,14 @@ def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
     versioned table store's publish/consume path (snapshot file ->
     consumer apply) before running the checks, so every equivalence
     property also holds for store-backed parameters.
+
+    lookahead_axis (ISSUE 9): additionally train this exact plan for a
+    few steps through the `schedule.LookaheadEngine` staged pipeline
+    and require BIT-exact agreement with the monolithic sparse step
+    (losses and final tables) — the prefetch/patch/drain restructuring
+    must be invisible across the whole random config space. Configs the
+    engine refuses by design (host-offloaded buckets, all-dp plans) are
+    skipped for this axis only.
 
     vocab_axis (ISSUE 7): run the batch as RAW int64 keys through a
     `vocab.VocabManager` over a slack-inflated plan — inputs reach the
@@ -210,7 +219,77 @@ def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
         np.testing.assert_allclose(b, np.asarray(a), rtol=train_rtol,
                                    atol=train_atol,
                                    err_msg=f"updated table {t}")
+    if lookahead_axis:
+        _check_lookahead_parity(dist, params, inputs, rng)
     return dist, params
+
+
+def _check_lookahead_parity(dist, params, inputs, rng, steps=3):
+    """Lookahead axis (ISSUE 9): the staged pipeline must be bit-exact
+    against the monolithic sparse step on THIS plan — same weights,
+    same batches (labels vary per step; ids repeat, which maximizes the
+    touched-row/prefetch intersection the patch has to fix)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from distributed_embeddings_tpu.schedule import LookaheadEngine
+    from distributed_embeddings_tpu.training import make_sparse_train_step
+
+    class _Head:
+        def __init__(self, emb):
+            self.embedding = emb
+
+        def loss_fn(self, p, numerical, cats, labels, taps=None,
+                    return_residuals=False):
+            if taps is not None or return_residuals:
+                outs, res = self.embedding(p["embedding"], list(cats),
+                                           taps=taps, return_residuals=True)
+            else:
+                outs = self.embedding(p["embedding"], list(cats))
+                res = None
+            x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs],
+                                axis=1).astype(jnp.float32)
+            loss = jnp.mean(((x @ p["head"])[:, 0]
+                             - labels.reshape(-1)) ** 2)
+            return (loss, res) if return_residuals else loss
+
+    model = _Head(dist)
+    outs = dist.apply(params, inputs)
+    feat = sum(int(np.prod(o.shape[1:])) for o in outs)
+    batch = int(outs[0].shape[0])
+    head = jnp.asarray(rng.randn(feat, 1).astype(np.float32)) * 0.1
+    if dist.mesh is not None:
+        head = jax.device_put(head,
+                              NamedSharding(dist.mesh, PartitionSpec()))
+    full = {"embedding": params, "head": head}
+    num = jnp.zeros((batch, 1), jnp.float32)
+    labels = [jnp.asarray(rng.randn(batch).astype(np.float32))
+              for _ in range(steps)]
+
+    try:
+        eng = LookaheadEngine(model, "adagrad", lr=0.05, donate=False,
+                              patch_capacity=batch)
+    except (NotImplementedError, ValueError):
+        return      # engine refuses this config by design (offload/all-dp)
+    init_fn, step_fn = make_sparse_train_step(model, "adagrad", lr=0.05,
+                                              donate=False)
+    p, s = full, init_fn(full)
+    mono = []
+    for i in range(steps):
+        p, s, loss = step_fn(p, s, num, list(inputs), labels[i])
+        mono.append(float(loss))
+    p2, s2 = full, eng.init(full)
+    batches = [(num, list(inputs), labels[i]) for i in range(steps)]
+    got = []
+    for i in range(steps):
+        nxt = batches[i + 1] if i + 1 < steps else None
+        p2, s2, loss = eng.step(p2, s2, batches[i], nxt)
+        got.append(float(loss))
+    assert mono == got, f"lookahead axis: loss trace diverged {mono} {got}"
+    w1 = dist.get_weights(p["embedding"])
+    w2 = dist.get_weights(p2["embedding"])
+    for t, (a, b) in enumerate(zip(w1, w2)):
+        np.testing.assert_array_equal(a, b,
+                                      err_msg=f"lookahead axis table {t}")
 
 
 ONE_HOT_8 = [(96, 8), (50, 8), (100, 16), (120, 8), (40, 16), (70, 8),

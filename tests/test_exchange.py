@@ -239,6 +239,54 @@ def test_touched_rows_per_step_schema():
             g["touched_rows_per_step"] * (8 + 4 * bucket.width))
 
 
+def test_lookahead_prefetch_report_schema():
+    """Overlap-window accounting (ISSUE 9): with `lookahead > 0` every
+    report group carries `prefetch_patch_rows_per_step` (worst case —
+    the previous step's touched rows all reappearing in the prefetched
+    batch, i.e. exactly `touched_rows_per_step` with its dedup bound)
+    and `prefetch_patch_bytes_per_step` (id wire + one activation slot
+    at the bucket's wire per patched row — the EXTRA exchange traffic
+    the overlap window adds). lookahead=0 reports zeros: the sequential
+    step has no patch."""
+    from distributed_embeddings_tpu.ops import wire as wire_ops
+
+    specs = [(96, 8, "sum"), (50, 8, "sum"), (100, 16, "sum"),
+             (120, 8, "sum")]
+    dist, _ = make_dist(specs, input_max_hotness=[4, 4, 4, 4])
+
+    r0 = dist.exchange_padding_report()
+    assert r0["lookahead"] == 0
+    assert r0["prefetch_patch_rows_per_step"] == 0
+    assert r0["prefetch_patch_bytes_per_step"] == 0
+    for g in r0["groups"]:
+        assert g["prefetch_patch_rows_per_step"] == 0
+        assert g["prefetch_patch_bytes_per_step"] == 0
+
+    r1 = dist.exchange_padding_report(lookahead=1, batch=64)
+    assert r1["lookahead"] == 1
+    for g in r1["groups"]:
+        bucket = dist.plan.tp_buckets[g["bucket"]]
+        assert (g["prefetch_patch_rows_per_step"]
+                == g["touched_rows_per_step"])
+        id_b = wire_ops.id_wire_itemsize(bucket.id_wire_dtype)
+        wire_b = wire_ops.wire_itemsize(bucket.wire_dtype)
+        assert g["prefetch_patch_bytes_per_step"] == (
+            g["prefetch_patch_rows_per_step"]
+            * (id_b + g["act_width"] * wire_b))
+    assert r1["prefetch_patch_rows_per_step"] == sum(
+        g["prefetch_patch_rows_per_step"] for g in r1["groups"])
+    assert r1["prefetch_patch_bytes_per_step"] == sum(
+        g["prefetch_patch_bytes_per_step"] for g in r1["groups"])
+    # batch scales the window until the dedup bound caps it
+    r_big = dist.exchange_padding_report(lookahead=1, batch=10 ** 6)
+    assert (r_big["prefetch_patch_rows_per_step"]
+            >= r1["prefetch_patch_rows_per_step"])
+    for g in r_big["groups"]:
+        bucket = dist.plan.tp_buckets[g["bucket"]]
+        assert (g["prefetch_patch_rows_per_step"]
+                <= dist.world_size * max(bucket.rows_max, 1))
+
+
 def test_vocab_occupancy_report_schema():
     """Capacity accounting (ISSUE 7): every report group carries
     `occupancy` (live rows / capacity rows), `slack_rows` (pre-reserved

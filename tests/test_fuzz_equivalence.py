@@ -61,6 +61,12 @@ def gen_config(seed):
         # slack-inflated plan — every equivalence property also holds
         # for dynamically-bound vocabularies
         kw["vocab_axis"] = True
+    if rng.rand() < 0.3:
+        # lookahead axis (ISSUE 9): train the same plan through the
+        # staged prefetch/patch/drain pipeline and require BIT-exact
+        # agreement with the monolithic sparse step (engine-refused
+        # configs — offloaded buckets, all-dp plans — skip the axis)
+        kw["lookahead_axis"] = True
     return specs, table_map, kw
 
 

@@ -1,0 +1,447 @@
+"""Lookahead execution engine (ISSUE 9): parity, patching, compile
+stability, overlap structure, refusals.
+
+The contract under test: `schedule.LookaheadEngine` at lookahead=0 IS
+the monolithic `make_sparse_train_step` (delegation), and at lookahead=1
+is BIT-exact against it — the prefetched activations are patched for the
+previous step's touched rows before the dense stage consumes them —
+across optimizers and both exchange wire paths, with a constant compile
+count and no extra sort ops.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_embeddings_tpu.layers.embedding import Embedding
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    DistributedEmbedding)
+from distributed_embeddings_tpu.parallel.mesh import create_mesh
+from distributed_embeddings_tpu.parallel.staging import DoubleBufferSlots
+from distributed_embeddings_tpu.schedule import (LookaheadEngine,
+                                                 default_lookahead)
+from distributed_embeddings_tpu.training import fit, make_sparse_train_step
+
+BATCH = 16
+SPECS = [(60, 8, "sum"), (40, 8, "sum"), (500, 16, "mean"), (120, 8, "sum")]
+
+
+class TinyModel:
+    """Embeddings -> concat -> linear head (a real dot for the dense
+    stage to overlap against)."""
+
+    def __init__(self, mesh, specs=SPECS, **kw):
+        self.specs = specs
+        self.embedding = DistributedEmbedding(
+            [Embedding(v, w, combiner=c) for v, w, c in specs],
+            mesh=mesh, **kw)
+
+    def loss_fn(self, params, numerical, cats, labels, taps=None,
+                return_residuals=False):
+        if taps is not None or return_residuals:
+            outs, res = self.embedding(params["embedding"], list(cats),
+                                       taps=taps, return_residuals=True)
+        else:
+            outs = self.embedding(params["embedding"], list(cats))
+            res = None
+        x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs],
+                            axis=1).astype(jnp.float32)
+        out = x @ params["head"]["w"]
+        loss = jnp.mean((out[:, 0] - labels.reshape(-1)) ** 2)
+        return (loss, res) if return_residuals else loss
+
+
+def _build(mesh, specs=SPECS, seed=0, **kw):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rng = np.random.RandomState(seed)
+    model = TinyModel(mesh, specs=specs, **kw)
+    weights = [rng.randn(v, w).astype(np.float32) * 0.1
+               for v, w, _ in specs]
+    head = rng.randn(sum(w for _, w, _ in specs), 1).astype(np.float32)
+    # the dense head enters REPLICATED: an uncommitted single-device
+    # array would re-specialize the step once its first output comes
+    # back replicated (true of the monolithic step too)
+    head = jax.device_put(jnp.asarray(head), NamedSharding(mesh, P()))
+    params = {"embedding": model.embedding.set_weights(weights),
+              "head": {"w": head}}
+    return model, params, weights
+
+
+def _batches(steps, specs=SPECS, seed=1):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(steps):
+        cats = [jnp.asarray(rng.randint(0, v, size=(BATCH, 2)))
+                for v, w, c in specs]
+        out.append((jnp.zeros((BATCH, 1)),
+                    cats,
+                    jnp.asarray(rng.randn(BATCH).astype(np.float32))))
+    return out
+
+
+def run_parity(optimizer, steps=5, patch_capacity=BATCH, stale_ok=False,
+               specs=SPECS, **engine_kw):
+    """Monolithic vs engine from identical init/data; returns
+    (mono_losses, eng_losses, engine) with final weights compared
+    bit-exactly when stale_ok is False."""
+    mesh = create_mesh(jax.devices()[:8])
+    model, params, _ = _build(mesh, specs=specs)
+    batches = _batches(steps, specs=specs)
+
+    init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.05,
+                                              donate=False)
+    p, s = params, init_fn(params)
+    mono = []
+    for num, cats, labels in batches:
+        p, s, loss = step_fn(p, s, num, list(cats), labels)
+        mono.append(float(loss))
+
+    model2, params2, _ = _build(mesh, specs=specs)
+    eng = LookaheadEngine(model2, optimizer, lr=0.05, donate=False,
+                          patch_capacity=patch_capacity,
+                          stale_ok=stale_ok, **engine_kw)
+    p2, s2 = params2, eng.init(params2)
+    got = []
+    for i, b in enumerate(batches):
+        nxt = batches[i + 1] if i + 1 < steps else None
+        p2, s2, loss = eng.step(p2, s2, b, nxt)
+        got.append(float(loss))
+
+    if not stale_ok:
+        assert mono == got, f"{optimizer}: loss trace diverged"
+        w1 = model.embedding.get_weights(p["embedding"])
+        w2 = model2.embedding.get_weights(p2["embedding"])
+        for t, (a, b) in enumerate(zip(w1, w2)):
+            np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+        np.testing.assert_array_equal(np.asarray(p["head"]["w"]),
+                                      np.asarray(p2["head"]["w"]))
+    return mono, got, eng
+
+
+# ---------------------------------------------------------------- parity
+def test_lookahead_bitexact_adagrad_padded():
+    _, _, eng = run_parity("adagrad")
+    # tiny vocab: nearly every prefetched sample touches a just-updated
+    # row — the patch path itself must have run, not just the fallback
+    assert eng.stats["patched_steps"] > 0
+
+
+def test_lookahead_bitexact_sgd_ragged(monkeypatch):
+    monkeypatch.setenv("DET_RAGGED_EXCHANGE", "1")
+    _, _, eng = run_parity("sgd")
+    assert eng.stats["patched_steps"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("optimizer", ["sgd", "adagrad", "adam"])
+@pytest.mark.parametrize("ragged", [False, True])
+def test_lookahead_bitexact_matrix(optimizer, ragged, monkeypatch):
+    monkeypatch.setenv("DET_RAGGED_EXCHANGE", "1" if ragged else "0")
+    run_parity(optimizer)
+
+
+def test_lookahead_bitexact_adam_padded():
+    run_parity("adam")
+
+
+@pytest.mark.slow
+def test_lookahead_bitexact_scheduled_lr():
+    """A schedule callable threads the step count through opt_state; the
+    engine's drain stage must rebuild the per-step sparse optimizer at
+    the same count the monolithic step would."""
+    sched = lambda step: 0.1 / (1.0 + jnp.asarray(step, jnp.float32))
+    mesh = create_mesh(jax.devices()[:8])
+    model, params, _ = _build(mesh)
+    batches = _batches(4)
+    init_fn, step_fn = make_sparse_train_step(model, "adagrad", lr=sched,
+                                              donate=False)
+    p, s = params, init_fn(params)
+    mono = []
+    for num, cats, labels in batches:
+        p, s, loss = step_fn(p, s, num, list(cats), labels)
+        mono.append(float(loss))
+    model2, params2, _ = _build(mesh)
+    eng = LookaheadEngine(model2, "adagrad", lr=sched, donate=False,
+                          patch_capacity=BATCH)
+    p2, s2 = params2, eng.init(params2)
+    got = []
+    for i, b in enumerate(batches):
+        p2, s2, loss = eng.step(p2, s2, b,
+                                batches[i + 1] if i + 1 < 4 else None)
+        got.append(float(loss))
+    assert mono == got
+    w1 = model.embedding.get_weights(p["embedding"])
+    w2 = model2.embedding.get_weights(p2["embedding"])
+    for t, (a, b) in enumerate(zip(w1, w2)):
+        np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+
+
+def test_patch_overflow_fallback_bitexact():
+    """A patch capacity smaller than the stale set per step forces the
+    full-reprefetch fallback — still bit-exact, zero extra compiles."""
+    _, _, eng = run_parity("adagrad", patch_capacity=8)
+    assert eng.stats["patch_overflows"] > 0
+    assert eng.compile_counts() == {"prefetch": 1, "fused": 1}
+
+
+def test_stale_ok_runs_and_diverges_boundedly():
+    """stale_ok skips the patch: losses stay finite and close, but the
+    bit-exact contract is explicitly forfeited (documented semantics)."""
+    mono, got, eng = run_parity("adagrad", stale_ok=True)
+    assert all(np.isfinite(got))
+    assert eng.stats["patched_steps"] == 0
+    dev = np.max(np.abs(np.asarray(mono) - np.asarray(got)))
+    assert dev < 1.0, f"one-step staleness blew up: {dev}"
+
+
+def test_lookahead_zero_delegates_to_monolithic():
+    mesh = create_mesh(jax.devices()[:8])
+    model, params, _ = _build(mesh)
+    batches = _batches(3)
+    init_fn, step_fn = make_sparse_train_step(model, "adagrad", lr=0.05,
+                                              donate=False)
+    p, s = params, init_fn(params)
+    model2, params2, _ = _build(mesh)
+    eng = LookaheadEngine(model2, "adagrad", lr=0.05, lookahead=0,
+                          donate=False)
+    p2, s2 = params2, eng.init(params2)
+    for i, (num, cats, labels) in enumerate(batches):
+        p, s, l1 = step_fn(p, s, num, list(cats), labels)
+        p2, s2, l2 = eng.step(p2, s2, batches[i],
+                              batches[i + 1] if i + 1 < 3 else None)
+        assert float(l1) == float(l2)
+
+
+# ------------------------------------------------------ compile stability
+def test_compile_count_stable():
+    """ONE compile per stage per (plan, batch-shape), regardless of how
+    many steps run or how often the patch/fallback paths alternate."""
+    mesh = create_mesh(jax.devices()[:8])
+    model, params, _ = _build(mesh)
+    eng = LookaheadEngine(model, "adagrad", lr=0.05, donate=False,
+                          patch_capacity=BATCH)
+    s = eng.init(params)
+    p = params
+    batches = _batches(6)
+    for i, b in enumerate(batches):
+        p, s, _ = eng.step(p, s, b, batches[i + 1] if i + 1 < 6 else None)
+    first = eng.compile_counts()
+    assert first == {"prefetch": 1, "fused": 1}, first
+    more = _batches(6, seed=7)
+    for i, b in enumerate(more):
+        p, s, _ = eng.step(p, s, b, more[i + 1] if i + 1 < 6 else None)
+    assert eng.compile_counts() == first, "recompiled under steady state"
+
+
+def test_pipeline_reset_and_cold_restart():
+    """reset() flushes the carry; the next step cold-fills from the
+    current tables and stays correct."""
+    mesh = create_mesh(jax.devices()[:8])
+    model, params, _ = _build(mesh)
+    batches = _batches(4)
+    init_fn, step_fn = make_sparse_train_step(model, "adagrad", lr=0.05,
+                                              donate=False)
+    p, s = params, init_fn(params)
+    mono = []
+    for num, cats, labels in batches:
+        p, s, loss = step_fn(p, s, num, list(cats), labels)
+        mono.append(float(loss))
+    model2, params2, _ = _build(mesh)
+    eng = LookaheadEngine(model2, "adagrad", lr=0.05, donate=False,
+                          patch_capacity=BATCH)
+    p2, s2 = params2, eng.init(params2)
+    got = []
+    for i, b in enumerate(batches):
+        if i == 2:
+            eng.reset()      # mid-run flush: forces a cold re-fill
+        nxt = batches[i + 1] if i + 1 < 4 else None
+        p2, s2, loss = eng.step(p2, s2, b, nxt)
+        got.append(float(loss))
+    assert mono == got
+    assert eng.stats["cold_fills"] >= 2
+
+
+# ------------------------------------------------------------ fit wiring
+def _fit_pair(lookahead, **fit_kw):
+    mesh = create_mesh(jax.devices()[:8])
+    model, params, _ = _build(mesh)
+    batches = _batches(6, seed=3)
+    p, s, hist = fit(model, params, iter(batches), steps=6,
+                     optimizer="adagrad", lr=0.05, log_every=0,
+                     lookahead=lookahead, **fit_kw)
+    return hist["loss"], hist
+
+
+def test_fit_lookahead_matches_sequential():
+    base, _ = _fit_pair(0)
+    ahead, hist = _fit_pair(1)
+    assert base == ahead
+    st = hist["lookahead_stats"]
+    assert st["steps"] == 6 and st["cold_fills"] >= 1
+
+
+def test_fit_lookahead_env_default(monkeypatch):
+    monkeypatch.setenv("DET_LOOKAHEAD", "1")
+    assert default_lookahead() == 1
+    losses, hist = _fit_pair(None)      # None -> DET_LOOKAHEAD
+    assert "lookahead_stats" in hist
+    monkeypatch.setenv("DET_LOOKAHEAD", "7")
+    with pytest.raises(ValueError, match="DET_LOOKAHEAD"):
+        default_lookahead()
+
+
+# -------------------------------------------------------------- refusals
+def test_refuses_hot_rows():
+    mesh = create_mesh(jax.devices()[:8])
+    model = TinyModel(mesh, hot_rows=8)
+    with pytest.raises(NotImplementedError, match="hot-row"):
+        LookaheadEngine(model, "adagrad", lr=0.05)
+
+
+def test_refuses_depth_beyond_one():
+    mesh = create_mesh(jax.devices()[:8])
+    model = TinyModel(mesh)
+    with pytest.raises(ValueError, match="lookahead"):
+        LookaheadEngine(model, "adagrad", lookahead=2)
+
+
+def test_refuses_all_dp_plan():
+    mesh = create_mesh(jax.devices()[:8])
+    model = TinyModel(mesh, specs=[(32, 8, "sum"), (16, 8, "sum")],
+                      data_parallel_threshold=10_000)
+    with pytest.raises(ValueError, match="nothing to prefetch"):
+        LookaheadEngine(model, "adagrad", lr=0.05)
+
+
+def test_refuses_ragged_input_form():
+    from distributed_embeddings_tpu.ops.embedding_ops import RaggedIds
+    mesh = create_mesh(jax.devices()[:8])
+    model, params, _ = _build(mesh)
+    eng = LookaheadEngine(model, "adagrad", lr=0.05, donate=False)
+    s = eng.init(params)
+    num, cats, labels = _batches(1)[0]
+    ragged = RaggedIds(jnp.arange(BATCH, dtype=jnp.int32),
+                       jnp.arange(BATCH + 1, dtype=jnp.int32))
+    bad = (num, [ragged] + cats[1:], labels)
+    with pytest.raises(NotImplementedError, match="dense id inputs"):
+        eng.step(params, s, bad, None)
+
+
+def test_fit_refuses_vocab_rebinds_and_hot_and_dense():
+    mesh = create_mesh(jax.devices()[:8])
+    model, params, _ = _build(mesh)
+    batches = _batches(2)
+
+    class _FakeVocab:     # fit's guard fires before any vocab use
+        emb = model.embedding
+
+    with pytest.raises(NotImplementedError, match="vocab_every"):
+        fit(model, params, iter(batches), steps=2, lookahead=1,
+            vocab=_FakeVocab(), vocab_every=4, log_every=0)
+    with pytest.raises(NotImplementedError, match="hot-row"):
+        fit(model, params, iter(batches), steps=2, lookahead=1,
+            hot_sync_every=2, log_every=0)
+    with pytest.raises(ValueError, match="sparse"):
+        fit(model, params, iter(batches), steps=2, lookahead=1,
+            sparse=False, log_every=0)
+
+
+# --------------------------------------------------- structure / overlap
+def test_hlo_collective_overlap_unit():
+    """The dependency classifier on a hand-written module: one collective
+    feeding a dot (serialized), one collective fed by a dot (serialized),
+    one free-floating (candidate), helpers reached via call."""
+    from distributed_embeddings_tpu.utils.profiling import (
+        hlo_collective_overlap)
+    text = """
+module @m {
+  func.func public @main(%arg0: tensor<8xf32>, %arg1: tensor<8xf32>) -> tensor<8xf32> {
+    %0 = "stablehlo.all_to_all"(%arg0) : (tensor<8xf32>) -> tensor<8xf32>
+    %1 = stablehlo.dot_general %0, %arg1, contracting_dims = [0] x [0] : (tensor<8xf32>, tensor<8xf32>) -> tensor<8xf32>
+    %2 = "stablehlo.all_gather"(%1) : (tensor<8xf32>) -> tensor<8xf32>
+    %3 = call @helper(%arg1) : (tensor<8xf32>) -> tensor<8xf32>
+    %4 = stablehlo.add %2, %3 : tensor<8xf32>
+    return %4 : tensor<8xf32>
+  }
+  func.func private @helper(%arg0: tensor<8xf32>) -> tensor<8xf32> {
+    %0 = "stablehlo.all_to_all"(%arg0) : (tensor<8xf32>) -> tensor<8xf32>
+    return %0 : tensor<8xf32>
+  }
+}
+"""
+    ov = hlo_collective_overlap(text)
+    assert ov["collectives_total"] == 3
+    assert ov["overlap_candidates"] == 1
+    assert ov["candidates_by_op"] == {"all_to_all": 1}
+    assert ov["serialized_collectives"] == 2
+
+
+def test_hlo_collective_overlap_region_conservative():
+    """Collectives inside control-flow REGIONS (a scanned step's while
+    body) fold into the enclosing node: a body mixing a collective with
+    a dot must classify as serialized, never as an overlap candidate —
+    the flat SSA graph cannot see the region's internal edges, so the
+    safe answer is 'no overlap'."""
+    from distributed_embeddings_tpu.utils.profiling import (
+        hlo_collective_overlap)
+    text = """
+module @m {
+  func.func public @main(%arg0: tensor<8xf32>) -> tensor<8xf32> {
+    %0 = "stablehlo.while"(%arg0) ({
+    ^bb0(%arg1: tensor<8xf32>):
+      %1 = "stablehlo.all_to_all"(%arg1) : (tensor<8xf32>) -> tensor<8xf32>
+      %2 = stablehlo.dot_general %1, %arg1, contracting_dims = [0] x [0] : (tensor<8xf32>, tensor<8xf32>) -> tensor<8xf32>
+      stablehlo.return %2 : tensor<8xf32>
+    }, {
+    ^bb1(%arg2: tensor<8xf32>):
+      stablehlo.return %arg2 : tensor<8xf32>
+    }) : (tensor<8xf32>) -> tensor<8xf32>
+    %3 = "stablehlo.all_gather"(%arg0) : (tensor<8xf32>) -> tensor<8xf32>
+    %4 = stablehlo.add %0, %3 : tensor<8xf32>
+    return %4 : tensor<8xf32>
+  }
+}
+"""
+    ov = hlo_collective_overlap(text)
+    assert ov["collectives_total"] == 2
+    # the while-body all_to_all shares a node with the dot -> serialized;
+    # the free-floating all_gather feeds only an add -> candidate
+    assert ov["overlap_candidates"] == 1
+    assert ov["candidates_by_op"] == {"all_gather": 1}
+
+
+def test_fused_step_overlap_audit():
+    """The real gate, on the real lowering: prefetch collectives carry no
+    dependency on the dense compute, the monolithic baseline audits to
+    zero candidates, and the fused step adds no sort ops."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "hlo_audit", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "hlo_audit.py"))
+    ha = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ha)
+    rec = ha.audit_lookahead_overlap(vocab=512, width=8, tables=2,
+                                     batch=16, hotness=2)
+    assert "skipped" not in rec, rec
+    assert rec["prefetch_collectives"] > 0
+    assert (rec["fused_overlap_candidates"]
+            >= rec["prefetch_collectives"]), rec
+    assert rec["baseline_overlap_candidates"] == 0, rec
+    assert rec["extra_sorts"] == 0, rec
+    assert rec["over_bound"] is False
+
+
+# -------------------------------------------------------- staging slots
+def test_double_buffer_slots():
+    s = DoubleBufferSlots()
+    assert s.current is None and s.take() is None
+    assert s.stage("a", tag=1) is None
+    assert s.current == "a" and s.tag == 1
+    assert s.stage("b", tag=2) is None          # "a" retired, not evicted
+    assert s.stage("c", tag=3) == "a"           # now "a" falls off
+    assert s.take() == "c"
+    assert s.current is None
+    s.clear()
+    assert s.stage("d") is None and s.current == "d"

@@ -39,12 +39,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _build_model(vocab: int, width: int, combiner: str, hot_rows: int = 0,
-                 tables: int = 1, mesh=None, exchange_wire=None):
+                 tables: int = 1, mesh=None, exchange_wire=None,
+                 dense_head: bool = False):
     """Minimal tapped model (the shape make_sparse_train_step expects)
     around a DistributedEmbedding — THE one copy of this harness, shared
-    by the sort-count arms, the collective-byte wire arms, and
-    bench.py's --mode wire A/B (via _load_hlo_audit), so the audit and
-    the bench always lower the same program."""
+    by the sort-count arms, the collective-byte wire arms, the lookahead
+    overlap arm, and bench.py's --mode wire / --mode lookahead A/Bs (via
+    _load_hlo_audit), so the audit and the bench always lower the same
+    program.
+
+    ``dense_head=True`` puts a real matmul between the embedding outputs
+    and the loss (params gain a ``head`` kernel, built by
+    ``_head_params``). The lookahead overlap audit classifies collectives
+    by dependency on dot ops — without a dot in the module the metric is
+    vacuous — and a dense head is what the pipeline overlaps against in
+    the first place."""
     import jax.numpy as jnp
     from distributed_embeddings_tpu.layers.dist_model_parallel import (
         DistributedEmbedding)
@@ -61,13 +70,25 @@ def _build_model(vocab: int, width: int, combiner: str, hot_rows: int = 0,
             outs, res = out if return_residuals else (out, None)
             x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs],
                                 axis=1)
-            loss = jnp.mean((jnp.sum(x, axis=1) - labels.reshape(-1)) ** 2)
+            if dense_head:
+                pred = (x.astype(jnp.float32) @ p["head"])[:, 0]
+            else:
+                pred = jnp.sum(x, axis=1)
+            loss = jnp.mean((pred - labels.reshape(-1)) ** 2)
             return (loss, res) if return_residuals else loss
 
     emb = DistributedEmbedding(
         [Embedding(vocab, width, combiner=combiner) for _ in range(tables)],
         mesh=mesh, hot_rows=hot_rows, exchange_wire=exchange_wire)
     return _Tapped(emb)
+
+
+def _head_params(tables: int, width: int, hotness: int, combiner: str):
+    """The replicated dense-head kernel matching _build_model's
+    ``dense_head=True`` loss (one output column)."""
+    import jax.numpy as jnp
+    per = width * (1 if combiner else hotness)
+    return jnp.zeros((tables * per, 1), jnp.float32)
 
 
 def audit_tapped_step(vocab: int = 30_000_000, width: int = 8,
@@ -195,6 +216,89 @@ def audit_exchange_bytes(wire: str = "f32", vocab: int = 4096,
     }
 
 
+def audit_lookahead_overlap(vocab: int = 4096, width: int = 32,
+                            tables: int = 4, batch: int = 64,
+                            hotness: int = 2, optimizer: str = "adagrad",
+                            world: int = 8, stale_ok: bool = False) -> dict:
+    """Lower the lookahead engine's FUSED staged step over a
+    `world`-device mesh and prove, on the dependency graph of the
+    StableHLO, that batch N+1's exchange collectives carry NO data
+    dependency on batch N's dense compute (ISSUE 9) — the static twin of
+    an ICI/MXU overlap measurement, checkable without hardware.
+
+    Three lowerings, one record:
+      * the fused step — its `overlap_candidates` (collectives with dot
+        ops on neither side, see profiling.hlo_collective_overlap) must
+        cover the whole prefetch stage;
+      * the standalone prefetch executable — defines how many
+        collectives that stage contains;
+      * the monolithic baseline step — must audit to ZERO candidates
+        (every exchange is on the dense critical path there), which
+        keeps the metric itself honest, and pins the sort bound: the
+        fused step must lower with NO extra stablehlo.sort ops vs the
+        monolithic step (the PR 2 gate carried over — the patch arm is a
+        sort-free plain recompute).
+    """
+    import jax
+    import jax.numpy as jnp
+    from distributed_embeddings_tpu.parallel.mesh import create_mesh
+    from distributed_embeddings_tpu.schedule import LookaheadEngine
+    from distributed_embeddings_tpu.training import make_sparse_train_step
+    from distributed_embeddings_tpu.utils.profiling import (
+        hlo_collective_overlap, hlo_op_counts)
+
+    devs = jax.devices()
+    if len(devs) < world:
+        return {"arm": "lookahead_overlap", "skipped":
+                f"need {world} devices for the meshed lowering, "
+                f"have {len(devs)}"}
+    mesh = create_mesh(devs[:world])
+    model = _build_model(vocab, width, "sum", tables=tables, mesh=mesh,
+                         dense_head=True)
+    emb = model.embedding
+    params = {"embedding": emb.init(jax.random.PRNGKey(0)),
+              "head": _head_params(tables, width, hotness, "sum")}
+    engine = LookaheadEngine(model, optimizer, lr=0.01,
+                             stale_ok=stale_ok, donate=False)
+    state = engine.init(params)
+    num = jnp.zeros((batch, 1), jnp.float32)
+    cats = [jnp.zeros((batch, hotness), jnp.int32) for _ in range(tables)]
+    lab = jnp.zeros((batch,), jnp.float32)
+    b0 = (num, cats, lab)
+
+    fused_txt = engine.lower_fused(params, state, b0, b0).as_text()
+    pre_txt = engine.lower_prefetch(params, cats).as_text()
+    init2, step2 = make_sparse_train_step(model, optimizer, lr=0.01,
+                                          donate=False)
+    base_txt = jax.jit(step2).lower(params, init2(params), num, cats,
+                                    lab).as_text()
+
+    fused_ov = hlo_collective_overlap(fused_txt)
+    pre_ov = hlo_collective_overlap(pre_txt)
+    base_ov = hlo_collective_overlap(base_txt)
+    fused_sorts = hlo_op_counts(fused_txt)["sort"]
+    base_sorts = hlo_op_counts(base_txt)["sort"]
+    rec = {
+        "arm": "lookahead_overlap", "optimizer": optimizer,
+        "world": world, "vocab": vocab, "width": width, "tables": tables,
+        "batch": batch, "hotness": hotness, "stale_ok": stale_ok,
+        "fused_collectives": fused_ov["collectives_total"],
+        "fused_overlap_candidates": fused_ov["overlap_candidates"],
+        "fused_candidates_by_op": fused_ov["candidates_by_op"],
+        "prefetch_collectives": pre_ov["collectives_total"],
+        "baseline_collectives": base_ov["collectives_total"],
+        "baseline_overlap_candidates": base_ov["overlap_candidates"],
+        "fused_sorts": fused_sorts, "baseline_sorts": base_sorts,
+        "extra_sorts": fused_sorts - base_sorts,
+    }
+    rec["over_bound"] = bool(
+        rec["prefetch_collectives"] == 0
+        or rec["fused_overlap_candidates"] < rec["prefetch_collectives"]
+        or rec["baseline_overlap_candidates"] != 0
+        or rec["extra_sorts"] > 0)
+    return rec
+
+
 # minimum float-collective-byte shrink the bf16 wire must show vs f32 on
 # the same lowered step — the wire moves half the bits, so the compiled
 # ratio is 2.0 minus whatever small float traffic is not behind the seam
@@ -242,14 +346,16 @@ def main(argv=None) -> int:
                    help="also report the fold_sort=False baseline arms")
     p.add_argument("--skip-wire", action="store_true",
                    help="skip the meshed collective-byte wire arms")
+    p.add_argument("--skip-lookahead", action="store_true",
+                   help="skip the meshed lookahead overlap arm")
     args = p.parse_args(argv)
 
     import jax
     jax.config.update("jax_platforms",
                       os.environ.get("JAX_PLATFORMS") or "cpu")
-    # the wire-byte arms lower over an 8-device mesh; virtual devices
-    # must be requested BEFORE the first backend touch below
-    if not args.skip_wire:
+    # the wire-byte and lookahead arms lower over an 8-device mesh;
+    # virtual devices must be requested BEFORE the first backend touch
+    if not (args.skip_wire and args.skip_lookahead):
         _ensure_world(8)
     failures = []
     for optimizer, strategy, lookup, hot_rows in DEFAULT_ARMS:
@@ -279,6 +385,16 @@ def main(argv=None) -> int:
             if red is None or red < WIRE_BYTE_MIN_REDUCTION:
                 comp["over_bound"] = True
                 failures.append(comp)
+    if not args.skip_lookahead:
+        # lookahead overlap arm (ISSUE 9): the fused staged step's
+        # prefetch collectives must be dependency-free of the dense
+        # compute (overlap candidates >= the whole prefetch stage), the
+        # monolithic baseline must audit to zero candidates, and the
+        # fused lowering must add ZERO sort ops vs the baseline
+        rec = audit_lookahead_overlap()
+        print(json.dumps(rec), flush=True)
+        if "skipped" not in rec and rec.get("over_bound"):
+            failures.append(rec)
     if args.do_assert and failures:
         print(f"hlo_audit: {len(failures)} arm(s) exceed their bound "
               "(sort count or collective bytes)", file=sys.stderr)
