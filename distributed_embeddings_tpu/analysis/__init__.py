@@ -1,0 +1,21 @@
+"""Static program analysis over lowered StableHLO (ISSUE 10).
+
+One parse, many auditors: ``ir`` is the typed IR layer (functions /
+instructions / operands / results with dtype+shape+attrs and the
+interprocedural call graph through jax's private ``shmap_body``
+structure), ``passes`` is the invariant-check framework
+(``(Module, PlanContext) -> list[Finding]``), and ``programs`` builds
+the standard audited program matrix plus the mutation fixtures that
+prove every pass can fail. ``tools/hlo_audit.py`` is the CLI driver;
+docs/analysis.md is the catalog.
+"""
+
+from . import ir, passes  # noqa: F401  (programs imports jax-heavy deps lazily)
+from .ir import (Module, parse_module, op_counts, collective_bytes,  # noqa: F401
+                 collective_overlap)
+from .passes import (Finding, PlanContext, run_passes,  # noqa: F401
+                     list_passes, PASS_REGISTRY)
+
+__all__ = ["ir", "passes", "Module", "parse_module", "op_counts",
+           "collective_bytes", "collective_overlap", "Finding",
+           "PlanContext", "run_passes", "list_passes", "PASS_REGISTRY"]

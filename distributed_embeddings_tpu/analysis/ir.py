@@ -1,0 +1,548 @@
+"""Typed StableHLO IR layer: parse a lowered module ONCE into
+functions/instructions/operands/results with dtype+shape+attrs and an
+interprocedural call graph (ISSUE 10).
+
+Three generations of bespoke HLO checks (the PR 2 sort gates, the PR 5
+collective-byte audit, the PR 8 overlap classifier) each re-walked the
+lowered StableHLO text with their own ad-hoc regexes. This module is the
+one parse they now share: ``parse_module(text)`` builds a :class:`Module`
+and the measurement functions (:func:`op_counts`,
+:func:`collective_bytes`, :func:`collective_overlap`) are the three
+legacy auditors ported onto it — behavior-identical, asserted against
+the regex era's recorded outputs on checked-in fixtures
+(tests/fixtures/hlo/expected_legacy.json) before the old parsers were
+deleted. ``analysis/passes.py`` layers invariant checks (findings) on
+top; ``tools/hlo_audit.py`` is the driver.
+
+Parsing model (matches what jax's ``.lower(...).as_text()`` emits):
+
+  * one :class:`Function` per ``func.func`` — public/private visibility,
+    arguments with their types and raw attribute text (donation /
+    aliasing markers live there), terminator operand refs;
+  * one :class:`Instruction` per TOP-LEVEL operation of a function body.
+    Operations inside nested regions (stablehlo.while / sort / reduce
+    bodies) FOLD INTO the enclosing instruction — their op mnemonics,
+    operand refs and (for collectives) operand types are recorded on the
+    owner as ``region_ops`` / ``region_refs`` — the same conservative
+    granularity the regex-era overlap classifier shipped with: a region
+    mixing collectives and compute taints one node, and its collectives
+    can never classify as overlap candidates;
+  * jax lowers ``shard_map`` bodies and jnp helpers to private functions
+    reached via ``call @shmap_body`` — the call graph (callees per
+    instruction, acyclic) is what makes the measurements
+    interprocedural.
+
+The parser is deliberately text-tolerant: it never throws on lines it
+does not understand (they land in ``Module.residual_text``), so a jax
+upgrade that changes printing degrades measurements instead of crashing
+audits. Every instruction keeps its source ``text`` — op-count semantics
+are TEXTUAL-MENTION counts (``#stablehlo.gather<...>`` attribute
+references count, exactly as the historical counter did), which is what
+keeps a decade of recorded baselines comparable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Type", "Argument", "Instruction", "Function", "Module",
+    "parse_module", "op_counts", "collective_bytes", "collective_overlap",
+    "COLLECTIVE_OPS", "COMPUTE_OPS", "DTYPE_BYTES",
+]
+
+# ------------------------------------------------------------ constants
+# payload-moving cross-device ops the byte/seam/overlap measurements
+# audit (psum lowers to all_reduce — a cross-device ACCUMULATION, not an
+# exchange; it is deliberately outside this set, see ops/wire.py's
+# declared-uncompressed contract)
+COLLECTIVE_OPS = ("ragged_all_to_all", "all_to_all", "all_gather",
+                  "reduce_scatter", "collective_permute")
+
+# dense-compute anchors of the overlap classification (the MXU work a
+# prefetch collective must be dependency-free of)
+COMPUTE_OPS = ("dot_general", "convolution")
+
+DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+               "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+               "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1}
+
+_LINE_RE = re.compile(r'^\s*(%[\w]+)(?::(\d+))?\s*=\s*(.*)$')
+_OP_RE = re.compile(r'"?(stablehlo|mhlo|chlo)\.([\w.]+)"?')
+# NOTE: intentionally unanchored, like the regex era: `custom_call
+# @Sharding` also "matches" as a callee — @Sharding is not a function in
+# the module, so the call-graph lookup is a no-op, but the parity with
+# recorded overlap numbers is exact.
+_CALL_RE = re.compile(r'(?:func\.)?call\s+@([\w$.-]+)')
+_FUNC_RE = re.compile(r'func\.func\s+(?:(public|private)\s+)?@([\w$.-]+)')
+_REF_RE = re.compile(r'%[A-Za-z0-9_]+')
+_TENSOR_RE = re.compile(r'tensor<([^>]*)>')
+_SIG_RE = re.compile(r':\s*\(([^()]*)\)\s*->\s*(.*?)\s*$', re.MULTILINE)
+_RET_RE = re.compile(r'^\s*(?:func\.)?return\b(.*)$')
+_ARG_RE = re.compile(r'%arg\d+')
+
+
+# ----------------------------------------------------------------- types
+@dataclasses.dataclass(frozen=True)
+class Type:
+    """One ``tensor<...>`` value type: element dtype + static shape.
+    Non-tensor or unparseable types keep ``dtype=None`` and measure as
+    0 elements (they carry no audited payload)."""
+
+    text: str
+    dtype: Optional[str] = None
+    shape: Tuple[Optional[int], ...] = ()
+
+    @classmethod
+    def parse(cls, text: str) -> "Type":
+        text = text.strip()
+        m = _TENSOR_RE.search(text)
+        if not m:
+            return cls(text=text)
+        parts = m.group(1).split("x")
+        dims: List[Optional[int]] = []
+        for p in parts[:-1]:
+            try:
+                dims.append(int(p))
+            except ValueError:
+                dims.append(None)      # dynamic '?' dimension
+        return cls(text=text, dtype=parts[-1], shape=tuple(dims))
+
+    @property
+    def elements(self) -> int:
+        n = 1
+        for d in self.shape:
+            if d is None:
+                return 0
+            n *= d
+        return n if self.dtype else 0
+
+    @property
+    def nbytes(self) -> int:
+        """Payload bytes; unknown dtypes default to 4 (the historical
+        convention the recorded byte baselines were measured under)."""
+        if not self.dtype:
+            return 0
+        return self.elements * DTYPE_BYTES.get(self.dtype, 4)
+
+
+def _parse_type_list(s: str) -> List[Type]:
+    return [Type.parse("tensor<" + inner + ">")
+            for inner in _TENSOR_RE.findall(s)]
+
+
+@dataclasses.dataclass
+class Argument:
+    """One function argument: SSA name, type, raw attribute text
+    (``{jax.buffer_donor = true, mhlo.sharding = ...}``)."""
+
+    name: str
+    type: Type
+    attrs: str = ""
+
+    @property
+    def donated(self) -> bool:
+        return "jax.buffer_donor" in self.attrs
+
+    @property
+    def aliased_output(self) -> Optional[int]:
+        m = re.search(r'tf\.aliasing_output\s*=\s*(\d+)', self.attrs)
+        return int(m.group(1)) if m else None
+
+
+@dataclasses.dataclass
+class Instruction:
+    """One top-level operation of a function body, regions folded in."""
+
+    kind: str                 # first op mnemonic ('all_to_all', 'call'…)
+    dialect: Optional[str]    # 'stablehlo' | 'mhlo' | 'chlo' | None
+    results: List[str]        # SSA base names produced (['%5'])
+    num_results: int
+    operands: List[str]       # %refs on the first line's rhs
+    callees: List[str]        # call targets (first line + region lines)
+    attrs: str                # raw '<{...}>' / '{...}' attribute text
+    line: int                 # 1-based source line of the first line
+    text: str                 # full source text (all folded lines)
+    region_ops: List[Tuple[Optional[str], str]] = \
+        dataclasses.field(default_factory=list)   # (dialect, kind)
+    region_refs: List[str] = dataclasses.field(default_factory=list)
+    region_collectives: List[Tuple[str, Type]] = \
+        dataclasses.field(default_factory=list)   # (kind, first-operand)
+    operand_types: List[Type] = dataclasses.field(default_factory=list)
+    result_types: List[Type] = dataclasses.field(default_factory=list)
+
+    @property
+    def ops(self) -> List[Tuple[Optional[str], str]]:
+        """(dialect, kind) of every operation this node owns — itself
+        plus its folded region ops (assignment lines)."""
+        return [(self.dialect, self.kind)] + self.region_ops
+
+    @property
+    def refs(self) -> List[str]:
+        return self.operands + self.region_refs
+
+    def is_collective(self, collectives=COLLECTIVE_OPS) -> bool:
+        return any(k in collectives for _, k in self.ops)
+
+    def collective_payloads(self, collectives=COLLECTIVE_OPS
+                            ) -> List[Tuple[str, Type]]:
+        """(kind, first-operand Type) per collective op on this node —
+        the payload the byte audit charges (metadata operands, e.g.
+        ragged_all_to_all's offset/size vectors, are bookkeeping)."""
+        out = []
+        if self.kind in collectives:
+            t = self.operand_types[0] if self.operand_types else Type("")
+            out.append((self.kind, t))
+        out.extend((k, t) for k, t in self.region_collectives
+                   if k in collectives)
+        return out
+
+    def _finalize(self) -> None:
+        """Parse the trailing type signature out of the accumulated
+        text: the LAST ``: (operand types) -> result types`` wins (for
+        region-carrying generic ops that is the region-closing line);
+        the pretty one-type form (``stablehlo.add %a, %b : tensor<…>``)
+        falls back to that single type for operands and results."""
+        sig = None
+        for sig in _SIG_RE.finditer(self.text):
+            pass
+        if sig is not None:
+            self.operand_types = _parse_type_list(sig.group(1))
+            self.result_types = _parse_type_list(sig.group(2))
+            return
+        m = re.search(r':\s*([^:()=]*?)\s*$', self.text)
+        if m:
+            tl = _parse_type_list(m.group(1))
+            if tl:
+                self.operand_types = tl if self.operands else []
+                self.result_types = tl
+
+
+@dataclasses.dataclass
+class Function:
+    name: str
+    visibility: str                  # 'public' | 'private'
+    args: List[Argument]
+    instructions: List[Instruction]
+    returns: List[str] = dataclasses.field(default_factory=list)
+    line: int = 0
+
+    @property
+    def donated_args(self) -> List[Argument]:
+        return [a for a in self.args
+                if a.donated or a.aliased_output is not None]
+
+    def producers(self) -> Dict[str, int]:
+        """SSA base name -> producing instruction index (top level)."""
+        return {r: i for i, inst in enumerate(self.instructions)
+                for r in inst.results}
+
+
+@dataclasses.dataclass
+class Module:
+    functions: Dict[str, Function]
+    source: str
+    residual_text: str = ""          # lines owned by no instruction
+
+    @property
+    def entry(self) -> Optional[Function]:
+        """The analyzed entry: @main when present, else the largest
+        function (the regex era's convention, kept for parity)."""
+        if "main" in self.functions:
+            return self.functions["main"]
+        if not self.functions:
+            return None
+        return max(self.functions.values(),
+                   key=lambda f: len(f.instructions))
+
+    def walk(self) -> Iterator[Tuple[Function, Instruction]]:
+        for fn in self.functions.values():
+            for inst in fn.instructions:
+                yield fn, inst
+
+    def call_graph(self) -> Dict[str, List[str]]:
+        """function -> callees that exist in this module (acyclic in
+        jax lowerings; cycles are tolerated by the summarizers)."""
+        return {name: [c for inst in fn.instructions
+                       for c in inst.callees if c in self.functions]
+                for name, fn in self.functions.items()}
+
+
+# ---------------------------------------------------------------- parse
+def _parse_args(sig_text: str) -> List[Argument]:
+    """Arguments from a ``func.func`` signature line. Attribute dicts can
+    contain braces and commas INSIDE quoted strings (mhlo.sharding), so
+    the split points are the ``%argN`` tokens themselves — nothing else
+    in a signature can look like one."""
+    body = sig_text.split("->")[0]
+    starts = [m for m in _ARG_RE.finditer(body)]
+    args = []
+    for i, m in enumerate(starts):
+        seg = body[m.end():starts[i + 1].start() if i + 1 < len(starts)
+                   else len(body)]
+        tm = _TENSOR_RE.search(seg)
+        am = re.search(r'\{(.*)\}', seg, re.DOTALL)
+        args.append(Argument(
+            name=m.group(0),
+            type=Type.parse("tensor<" + tm.group(1) + ">") if tm
+            else Type(seg.strip(" :,()")),
+            attrs=am.group(1) if am else ""))
+    return args
+
+
+def parse_module(text) -> Module:
+    """Parse StableHLO MLIR text (or a ``jax.jit(f).lower(...)`` result)
+    into a :class:`Module`. Never raises on unrecognized lines."""
+    if not isinstance(text, str):
+        text = text.as_text()
+    functions: Dict[str, Function] = {}
+    residual: List[str] = []
+    cur: Optional[Function] = None
+    depth = 0
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        fm = _FUNC_RE.search(raw)
+        if fm:
+            cur = Function(name=fm.group(2),
+                           visibility=fm.group(1) or "public",
+                           args=_parse_args(raw), instructions=[],
+                           line=lineno)
+            functions[cur.name] = cur
+            # the signature line's opening brace is the body baseline
+            depth = raw.count("{") - raw.count("}")
+            continue
+        if cur is None:
+            residual.append(raw)
+            continue
+        at_top = depth <= 1
+        depth += raw.count("{") - raw.count("}")
+        m = _LINE_RE.match(raw)
+        if at_top and m:
+            lhs, nres, rhs = m.group(1), m.group(2), m.group(3)
+            callee_m = _CALL_RE.search(rhs)
+            op_m = _OP_RE.search(rhs)
+            if op_m:
+                dialect, kind = op_m.group(1), op_m.group(2)
+            elif callee_m:
+                dialect, kind = None, "call"
+            else:
+                dialect = None
+                kind = rhs.split("(")[0].split()[0] if rhs.split() else ""
+            am = re.search(r'<\{(.*)\}>', rhs, re.DOTALL)
+            cur.instructions.append(Instruction(
+                kind=kind, dialect=dialect, results=[lhs],
+                num_results=int(nres) if nres else 1,
+                operands=_REF_RE.findall(rhs),
+                callees=[callee_m.group(1)] if callee_m else [],
+                attrs=am.group(1) if am else "",
+                line=lineno, text=raw))
+        elif at_top:
+            rm = _RET_RE.match(raw)
+            if rm:
+                cur.returns.extend(
+                    t.split("#")[0] for t in _REF_RE.findall(rm.group(1)))
+            residual.append(raw)
+        else:
+            # region line: folds into the enclosing instruction (or
+            # opens one if the body somehow starts nested — parity with
+            # the regex era's owner-or-new fallback)
+            if not cur.instructions:
+                cur.instructions.append(Instruction(
+                    kind="", dialect=None, results=[], num_results=0,
+                    operands=[], callees=[], attrs="", line=lineno,
+                    text=""))
+            owner = cur.instructions[-1]
+            owner.text += "\n" + raw
+            if m:
+                rhs = m.group(3)
+                callee_m = _CALL_RE.search(rhs)
+                op_m = _OP_RE.search(rhs)
+                if op_m:
+                    d, k = op_m.group(1), op_m.group(2)
+                elif callee_m:
+                    d, k = None, "call"
+                else:
+                    d = None
+                    k = (rhs.split("(")[0].split()[0]
+                         if rhs.split() else "")
+                owner.region_ops.append((d, k))
+                owner.region_refs.extend(_REF_RE.findall(rhs))
+                if callee_m:
+                    owner.callees.append(callee_m.group(1))
+                if k in COLLECTIVE_OPS:
+                    # a collective nested in control flow still carries
+                    # payload: charge its own line's first operand type
+                    sig = _SIG_RE.search(raw)
+                    t = (_parse_type_list(sig.group(1))
+                         if sig else [])
+                    owner.region_collectives.append(
+                        (k, t[0] if t else Type("")))
+    for fn in functions.values():
+        for inst in fn.instructions:
+            inst._finalize()
+    return Module(functions=functions, source=text,
+                  residual_text="\n".join(residual))
+
+
+# ---------------------------------------------------- ported measurements
+def _as_module(lowered) -> Module:
+    return lowered if isinstance(lowered, Module) else parse_module(lowered)
+
+
+def op_counts(lowered, ops: Sequence[str] = ("sort", "scatter", "gather",
+                                             "all_to_all")) -> dict:
+    """StableHLO op-mention counts — the PR 2 sort-gate measurement,
+    ported. Counts are TEXTUAL mentions as whole words (``stablehlo.sort``
+    counts, ``sort_key`` identifiers do not; attribute-embedded
+    references like ``#stablehlo.gather<...>`` DO count, one per gather
+    op in practice) — per textual instance, not per call-site execution.
+    Identical by construction to the regex era (every source line lands
+    in exactly one instruction's text or the residual), and asserted so
+    on recorded fixtures."""
+    mod = _as_module(lowered)
+    pats = {op: re.compile(rf'stablehlo\.{re.escape(op)}\b')
+            for op in ops}
+    out = {op: len(pat.findall(mod.residual_text))
+           for op, pat in pats.items()}
+    for _, inst in mod.walk():
+        for op, pat in pats.items():
+            out[op] += len(pat.findall(inst.text))
+    return out
+
+
+def collective_bytes(lowered, collectives=COLLECTIVE_OPS) -> dict:
+    """Collective payload (first-operand) bytes by element dtype — the
+    PR 5 wire-audit measurement, ported. Shapes inside shard_map bodies
+    are PER-DEVICE; ratios between two lowerings of the same program are
+    what audits assert, not absolute fleet bytes
+    (``analysis.programs.expected_collective_bytes`` is the exact
+    model-side twin when fleet accounting is needed).
+
+    Returns {op: {dtype: bytes}, "total": {dtype: bytes},
+    "float_bytes": int, "int_bytes": int}."""
+    mod = _as_module(lowered)
+    out: dict = {op: {} for op in collectives}
+    total: dict = {}
+    for _, inst in mod.walk():
+        for kind, t in inst.collective_payloads(collectives):
+            if not t.dtype:
+                continue
+            out[kind][t.dtype] = out[kind].get(t.dtype, 0) + t.nbytes
+            total[t.dtype] = total.get(t.dtype, 0) + t.nbytes
+    out["total"] = total
+    out["float_bytes"] = sum(v for k, v in total.items()
+                             if k in ("f64", "f32", "bf16", "f16", "f8"))
+    out["int_bytes"] = sum(v for k, v in total.items()
+                           if k.startswith(("i", "ui")))
+    return out
+
+
+def collective_overlap(lowered, collectives=COLLECTIVE_OPS,
+                       compute_ops=COMPUTE_OPS) -> dict:
+    """Classify every collective by its dependency relation to the
+    module's dense compute — the PR 8 lookahead overlap measurement,
+    ported. A collective with dot/convolution ops in NEITHER its
+    transitive fan-in NOR fan-out is an **overlap candidate**: no data
+    dependency orders it against the dense stage, so XLA's
+    latency-hiding scheduler may run it concurrently with MXU work.
+
+    Granularity is the call SITE in the entry function: private helpers
+    (shmap_body and friends) are summarized transitively, a call site
+    inherits its callee's collective counts and compute content, and a
+    site that itself contains compute (or a region mixing both) is never
+    a candidate — conservative where imprecise. Region-folded
+    instructions classify as one node (see module docstring).
+
+    Returns {"collectives_total", "overlap_candidates",
+    "serialized_collectives", "candidates_by_op", "compute_sites"}."""
+    mod = _as_module(lowered)
+    empty = {"collectives_total": 0, "overlap_candidates": 0,
+             "serialized_collectives": 0, "candidates_by_op": {},
+             "compute_sites": 0}
+    entry = mod.entry
+    if entry is None:
+        return empty
+
+    summaries: Dict[str, dict] = {}
+
+    def summarize(fname: str, stack=()) -> dict:
+        if fname in summaries:
+            return summaries[fname]
+        fn = mod.functions.get(fname)
+        if fn is None or fname in stack:
+            return {"coll": {}, "compute": False}
+        coll: dict = {}
+        compute = False
+        for inst in fn.instructions:
+            for _, kind in inst.ops:
+                if kind in collectives:
+                    coll[kind] = coll.get(kind, 0) + 1
+                if kind in compute_ops:
+                    compute = True
+            for callee in inst.callees:
+                sub = summarize(callee, stack + (fname,))
+                compute = compute or sub["compute"]
+                for k, v in sub["coll"].items():
+                    coll[k] = coll.get(k, 0) + v
+        summaries[fname] = {"coll": coll, "compute": compute}
+        return summaries[fname]
+
+    body = entry.instructions
+    n = len(body)
+    producer = entry.producers()
+    deps = [[producer[r] for r in inst.refs if r in producer]
+            for inst in body]
+    node_coll: List[dict] = []
+    node_compute: List[bool] = []
+    for inst in body:
+        c: dict = {}
+        compute = False
+        for _, kind in inst.ops:
+            if kind in collectives:
+                c[kind] = c.get(kind, 0) + 1
+            if kind in compute_ops:
+                compute = True
+        for callee in inst.callees:
+            sub = summarize(callee)
+            compute = compute or sub["compute"]
+            for k, v in sub["coll"].items():
+                c[k] = c.get(k, 0) + v
+        node_coll.append(c)
+        node_compute.append(compute)
+
+    # SSA text order is topological: one forward pass taints fan-ins,
+    # one reverse pass taints fan-outs
+    dot_in_fanin = [False] * n
+    for i in range(n):
+        dot_in_fanin[i] = any(node_compute[d] or dot_in_fanin[d]
+                              for d in deps[i])
+    consumers: List[List[int]] = [[] for _ in range(n)]
+    for i, ds in enumerate(deps):
+        for d in ds:
+            consumers[d].append(i)
+    dot_in_fanout = [False] * n
+    for i in range(n - 1, -1, -1):
+        dot_in_fanout[i] = any(node_compute[c] or dot_in_fanout[c]
+                               for c in consumers[i])
+
+    total = 0
+    candidates = 0
+    cand_by_op: dict = {}
+    for i in range(n):
+        cnt = sum(node_coll[i].values())
+        if not cnt:
+            continue
+        total += cnt
+        # a site that itself CONTAINS compute is never a candidate (the
+        # collective may order against its own callee's dots)
+        if (not dot_in_fanin[i] and not dot_in_fanout[i]
+                and not node_compute[i]):
+            candidates += cnt
+            for k, v in node_coll[i].items():
+                cand_by_op[k] = cand_by_op.get(k, 0) + v
+    return {"collectives_total": total,
+            "overlap_candidates": candidates,
+            "serialized_collectives": total - candidates,
+            "candidates_by_op": cand_by_op,
+            "compute_sites": sum(node_compute)}

@@ -184,47 +184,12 @@ jax.tree_util.register_pytree_node(
     TapResiduals, TapResiduals.tree_flatten, TapResiduals.tree_unflatten)
 
 
-def _ragged_exchange_op(operand, output, in_off, send_sz, out_off, recv_sz,
-                        axis: str, native: bool):
-    """One true-splits all-to-all: sends `send_sz[d]` rows of `operand`
-    (starting at `in_off[d]`) to each device d, landing at `out_off[d]` in
-    d's `output`; `recv_sz[s]` rows arrive from each source s. This is the
-    reference's `hvd.alltoall(x, splits)` contract
-    (dist_model_parallel.py:134, :211): wire bytes are the true nnz, not
-    the padded block.
-
-    native=True lowers to `lax.ragged_all_to_all` (TPU; XLA:CPU has no
-    lowering — see tools/tpu_ragged_check.py). native=False runs a
-    semantics-exact emulation from equal-shaped collectives (all_gather +
-    masked gather) so the FULL exchange path — metadata, layouts,
-    reassembly — is executable and equivalence-tested on the CPU mesh;
-    only the op itself differs, and that op is validated on hardware by
-    the 'ragged' stage of tools/tpu_validate.py.
-    """
-    if native:
-        return lax.ragged_all_to_all(operand, output, in_off, send_sz,
-                                     out_off, recv_sz, axis_name=axis)
-    ops = lax.all_gather(operand, axis)            # [world, S, inner]
-    g_in = lax.all_gather(in_off, axis)            # [world, world]
-    g_send = lax.all_gather(send_sz, axis)
-    g_out = lax.all_gather(out_off, axis)
-    me = lax.axis_index(axis)
-    n_out = output.shape[0]
-    i = jnp.arange(n_out)
-    starts = g_out[:, me]                          # my chunk starts, per src
-    # receive extent honors BOTH sides' metadata (sender's send_sz and my
-    # recv_sz), so a wrong recv_sz corrupts the emulation the same way it
-    # would corrupt the native op — CPU tests catch it
-    sizes = jnp.minimum(g_send[:, me], recv_sz)
-    src0 = g_in[:, me]
-    m = ((i[None, :] >= starts[:, None])
-         & (i[None, :] < (starts + sizes)[:, None]))   # [world, n_out]
-    valid = jnp.any(m, axis=0)
-    s_idx = jnp.argmax(m, axis=0)
-    src_row = jnp.clip(src0[s_idx] + i - starts[s_idx], 0,
-                       operand.shape[0] - 1)
-    gathered = ops[s_idx, src_row]
-    return jnp.where(valid[:, None], gathered, output)
+# The true-splits (ragged) exchange op lives behind the wire seam with
+# every other exchange collective (ISSUE 10): `ops.wire.ragged_exchange`
+# — native lax.ragged_all_to_all on TPU, the equal-shaped-collective
+# emulation on CPU. Alias kept: this module's exchange paths call it by
+# its historical name.
+_ragged_exchange_op = wire_ops.ragged_exchange
 
 
 # (backend, world_size) -> bool: did the 'native' (compute_on jit) host
@@ -856,7 +821,16 @@ class DistributedEmbedding:
         the statically auditable compression claim: 2.0 when every
         bucket rides bf16, 1.0 at the f32 default. The gradient
         transpose moves the same activation volume again (same ratio);
-        weighted inputs add `weight_bytes_if_weighted` per group.
+        weighted inputs add `weight_bytes_if_weighted` per group —
+        FORWARD-only (weights are inputs, not params: no gradient
+        crosses the weight wire). Id fields charge the NARROWED id
+        dtype (an int16 bucket's wire moves 2 B/id, exactly what the
+        lowered operand carries). `analysis.programs.
+        expected_collective_bytes` converts these per-sample fields
+        into the exact per-device HLO payload bytes, and the
+        collective-bytes audit pass + tests/test_wire.py assert the
+        compiled program matches the model byte-for-byte on every wire
+        config (ISSUE 10 reconciliation).
 
         Touched-row accounting (ISSUE 6): every group also carries
         `touched_rows_per_step` — the dedup'd post-sentinel-mask ids the
@@ -1539,11 +1513,8 @@ class DistributedEmbedding:
                 blocal, world, grp.f_max, grp.k)
             w_send = jnp.moveaxis(w_send, 1, 0)
         if world > 1:
-            recv = wire_ops.decode_ids(
-                lax.all_to_all(
-                    wire_ops.encode_ids(send, bucket.id_wire_dtype),
-                    self.axis, split_axis=0, concat_axis=0),
-                bucket.id_wire_dtype, send.dtype)
+            recv = wire_ops.wire_id_all_to_all(send, self.axis,
+                                               bucket.id_wire_dtype)
             if w is not None:
                 w_recv = wire_ops.wire_all_to_all(w_send, self.axis,
                                                   bucket.wire_dtype)
@@ -1725,11 +1696,8 @@ class DistributedEmbedding:
         bucket = self.plan.tp_buckets[grp.bucket]
         if not self._use_ragged_exchange(grp, world):
             if world > 1:
-                recv = wire_ops.decode_ids(
-                    lax.all_to_all(
-                        wire_ops.encode_ids(send, bucket.id_wire_dtype),
-                        self.axis, split_axis=0, concat_axis=0),
-                    bucket.id_wire_dtype, send.dtype)
+                recv = wire_ops.wire_id_all_to_all(send, self.axis,
+                                                   bucket.id_wire_dtype)
                 w_recv = (None if w_send is None else
                           wire_ops.wire_all_to_all(w_send, self.axis,
                                                    bucket.wire_dtype))
@@ -1926,11 +1894,8 @@ class DistributedEmbedding:
                 # wire formats (ISSUE 5) from the row-table plan: int16
                 # id wire where the TOTAL row count provably fits, the
                 # float wire on the weight broadcast
-                ids = wire_ops.decode_ids(
-                    lax.all_gather(
-                        wire_ops.encode_ids(ids, rt.id_wire_dtype),
-                        self.axis, axis=0, tiled=True),
-                    rt.id_wire_dtype, ids.dtype)
+                ids = wire_ops.wire_id_all_gather(ids, self.axis,
+                                                  rt.id_wire_dtype)
                 if weights is not None:
                     weights = wire_ops.wire_all_gather(
                         weights, self.axis, rt.wire_dtype, world)

@@ -76,6 +76,12 @@ __all__ = [
     "wire_psum_scatter",
     "wire_all_to_all_t",
     "wire_psum_scatter_t",
+    "wire_id_all_to_all",
+    "wire_id_all_gather",
+    "ragged_exchange",
+    "seam_float_dtypes",
+    "seam_id_dtypes",
+    "RAGGED_METADATA_DTYPES",
 ]
 
 WIRE_FORMATS = ("f32", "bf16", "bf16-sr")
@@ -342,3 +348,112 @@ def wire_psum_scatter_t(g: jax.Array, axis: str, wire: str,
         return lax.all_gather(g, axis, axis=0, tiled=True)
     h = lax.all_gather(encode_bwd(g, wire), axis, axis=0, tiled=True)
     return h.astype(g.dtype)
+
+
+# ------------------------------------------------------ id-wire exchanges
+# Int ids carry no gradient, so these are plain (not custom_vjp)
+# collectives behind the encode/decode pair — but they ARE exchange
+# collectives, and the repo invariant (ISSUE 10, tools/lint_invariants.py
+# 'naked-collective') is that every one of those lives in this module:
+# the static wire-seam audit (analysis/passes.py) attributes every
+# lowered collective's payload dtype to a plan group's declared format,
+# and an id exchange assembled inline at a call site is exactly the kind
+# of seam escape it exists to catch.
+
+def wire_id_all_to_all(ids: jax.Array, axis: str, id_wire: str) -> jax.Array:
+    """dp->mp id-block `all_to_all` (split 0 / concat 0) behind the id
+    wire seam: int16 on the wire where the planner proved the key space
+    fits (lossless — see `encode_ids` clip semantics), the caller's
+    dtype on both sides."""
+    return decode_ids(
+        lax.all_to_all(encode_ids(ids, id_wire), axis,
+                       split_axis=0, concat_axis=0),
+        id_wire, ids.dtype)
+
+
+def wire_id_all_gather(ids: jax.Array, axis: str, id_wire: str) -> jax.Array:
+    """Tiled id `all_gather` over axis 0 behind the id wire seam (the
+    row-sliced path's id broadcast)."""
+    return decode_ids(
+        lax.all_gather(encode_ids(ids, id_wire), axis, axis=0,
+                       tiled=True),
+        id_wire, ids.dtype)
+
+
+def ragged_exchange(operand, output, in_off, send_sz, out_off, recv_sz,
+                    axis: str, native: bool):
+    """One true-splits all-to-all: sends `send_sz[d]` rows of `operand`
+    (starting at `in_off[d]`) to each device d, landing at `out_off[d]` in
+    d's `output`; `recv_sz[s]` rows arrive from each source s. This is the
+    reference's `hvd.alltoall(x, splits)` contract
+    (dist_model_parallel.py:134, :211): wire bytes are the true nnz, not
+    the padded block.
+
+    native=True lowers to `lax.ragged_all_to_all` (TPU; XLA:CPU has no
+    lowering — see tools/tpu_ragged_check.py). native=False runs a
+    semantics-exact emulation from equal-shaped collectives (all_gather +
+    masked gather) so the FULL exchange path — metadata, layouts,
+    reassembly — is executable and equivalence-tested on the CPU mesh;
+    only the op itself differs, and that op is validated on hardware by
+    the 'ragged' stage of tools/tpu_validate.py.
+
+    The OPERAND must already be wire-encoded by the caller (the bucket's
+    float or id format); the emulation's three metadata all_gathers move
+    int32 offsets/sizes — `RAGGED_METADATA_DTYPES`, the one int32
+    collective payload the wire-seam audit admits beyond the declared id
+    wires when a program takes the emulated ragged path."""
+    if native:
+        return lax.ragged_all_to_all(operand, output, in_off, send_sz,
+                                     out_off, recv_sz, axis_name=axis)
+    ops = lax.all_gather(operand, axis)            # [world, S, inner]
+    g_in = lax.all_gather(in_off, axis)            # [world, world]
+    g_send = lax.all_gather(send_sz, axis)
+    g_out = lax.all_gather(out_off, axis)
+    me = lax.axis_index(axis)
+    n_out = output.shape[0]
+    i = jnp.arange(n_out)
+    starts = g_out[:, me]                          # my chunk starts, per src
+    # receive extent honors BOTH sides' metadata (sender's send_sz and my
+    # recv_sz), so a wrong recv_sz corrupts the emulation the same way it
+    # would corrupt the native op — CPU tests catch it
+    sizes = jnp.minimum(g_send[:, me], recv_sz)
+    src0 = g_in[:, me]
+    m = ((i[None, :] >= starts[:, None])
+         & (i[None, :] < (starts + sizes)[:, None]))   # [world, n_out]
+    valid = jnp.any(m, axis=0)
+    s_idx = jnp.argmax(m, axis=0)
+    src_row = jnp.clip(src0[s_idx] + i - starts[s_idx], 0,
+                       operand.shape[0] - 1)
+    gathered = ops[s_idx, src_row]
+    return jnp.where(valid[:, None], gathered, output)
+
+
+# --------------------------------------------- static-audit attribution
+# Pass-readable byte/dtype attribution hooks (ISSUE 10): the wire-seam
+# and dtype-promotion passes (analysis/passes.py) read the legal
+# StableHLO payload element types off the SAME module that implements
+# the encodings, so the audit and the seam cannot drift. NOT attributed
+# here by design: cross-device ACCUMULATIONS (hot-shard psum, loss
+# psum) lower to `all_reduce`, which is outside the audited exchange
+# collective set — they are the declared-uncompressed remainder.
+
+# the ragged emulation's offset/size metadata all_gathers (see
+# `ragged_exchange`) — int32 regardless of the bucket's id wire
+RAGGED_METADATA_DTYPES = ("i32",)
+
+
+def seam_float_dtypes(wire: str):
+    """StableHLO element types a float exchange at `wire` may put on a
+    collective ('f32' early-returns to the plain lax collective; every
+    compressed format crosses as bf16)."""
+    return ("f32",) if resolve_wire(wire) == "f32" else ("bf16",)
+
+
+def seam_id_dtypes(id_wire: str):
+    """StableHLO element types the id wire at `id_wire` may put on a
+    collective ('auto' covers both: the planner narrows per bucket)."""
+    if id_wire == "int16":
+        return ("i16",)
+    if id_wire == "int32":
+        return ("i32",)
+    return ("i16", "i32")

@@ -15,7 +15,7 @@ tools exist; this module packages the two workflows:
 import contextlib
 import statistics
 import time
-from typing import Any, Callable, NamedTuple, Sequence
+from typing import Callable, NamedTuple, Sequence
 
 import jax
 
@@ -26,86 +26,58 @@ __all__ = ["BenchResult", "benchmark", "benchmark_batches", "trace",
 
 def hlo_op_counts(lowered, ops: Sequence[str] = ("sort", "scatter", "gather",
                                                  "all_to_all")) -> dict:
-    """Count StableHLO ops in a lowered (not yet compiled) jax program.
+    """Count StableHLO op mentions in a lowered (not yet compiled) jax
+    program — the static twin of a profiler trace: op COUNTS are decided
+    at trace time, so regressions like "the train step re-sorts the same
+    ids three times" (docs/perf_model.md 'Sort folding') are catchable on
+    any backend, hardware or not.
 
-    The static twin of a profiler trace: op COUNTS are decided at trace
-    time, so regressions like "the train step re-sorts the same ids three
-    times" (docs/perf_model.md 'Sort folding') are catchable on any
-    backend, hardware or not — tools/hlo_audit.py builds the repo's
-    regression gate on this.
+    Ported onto the typed IR (`analysis.ir.op_counts`, ISSUE 10) —
+    behavior-identical to the regex era, asserted on recorded fixtures:
+    counts are per TEXTUAL mention as whole words (``sort`` counts
+    ``stablehlo.sort`` but not ``sort_key``; attribute-embedded
+    references like ``#stablehlo.gather<...>`` count too), stable for
+    equality/upper-bound assertions, not a dynamic execution count.
 
     Args:
-      lowered: a ``jax.jit(f).lower(...)`` result, or its ``.as_text()``
-        string (StableHLO MLIR).
-      ops: StableHLO op mnemonics, counted as whole words (``sort`` counts
-        ``stablehlo.sort`` but not ``sort_key`` identifiers).
+      lowered: a ``jax.jit(f).lower(...)`` result, its ``.as_text()``
+        string (StableHLO MLIR), or a pre-parsed ``analysis.ir.Module``.
+      ops: StableHLO op mnemonics.
 
-    Returns {op: count}. Counts are per textual op instance; an op inside
-    a called sub-function counts once per textual occurrence, not per call
-    site — stable for equality/upper-bound assertions, not a dynamic
-    execution count.
+    Returns {op: count}.
     """
-    import re
-    text = lowered if isinstance(lowered, str) else lowered.as_text()
-    return {op: len(re.findall(rf'stablehlo\.{re.escape(op)}\b', text))
-            for op in ops}
+    from distributed_embeddings_tpu.analysis import ir
+    return ir.op_counts(_hlo_text(lowered), ops)
 
 
-_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
-                "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
-                "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1}
+def _hlo_text(lowered):
+    from distributed_embeddings_tpu.analysis import ir
+    if isinstance(lowered, (str, ir.Module)):
+        return lowered
+    return lowered.as_text()
+
 
 _COLLECTIVES = ("ragged_all_to_all", "all_to_all", "all_gather",
                 "reduce_scatter", "collective_permute")
 
 
 def hlo_collective_bytes(lowered, collectives=_COLLECTIVES) -> dict:
-    """Sum the operand bytes of each collective op in a lowered program,
-    split by element dtype — the byte-level twin of `hlo_op_counts` and
-    the static audit behind the wire-compression claim (ISSUE 5,
-    docs/perf_model.md "Wire compression"): whether the compiled step's
-    exchange operands actually narrowed is decided at trace time, so a
-    bf16-wire regression is catchable on any backend, no hardware.
+    """Sum the payload (first-operand) bytes of each collective op in a
+    lowered program, split by element dtype — the byte-level twin of
+    `hlo_op_counts` and the static audit behind the wire-compression
+    claim (ISSUE 5, docs/perf_model.md "Wire compression"). Ported onto
+    the typed IR (`analysis.ir.collective_bytes`, ISSUE 10).
 
-    Only the FIRST operand of each op is counted (the payload; e.g.
-    `ragged_all_to_all`'s five metadata operands are bookkeeping).
     Shapes inside shard_map bodies are per-device — ratios between two
-    lowerings of the same program are what the audit asserts, not
-    absolute fleet bytes.
-
-    Args:
-      lowered: ``jax.jit(f).lower(...)`` result or its ``.as_text()``.
-      collectives: StableHLO op mnemonics to scan.
+    lowerings of the same program are what the audit asserts;
+    `analysis.programs.expected_collective_bytes` is the exact
+    model-side twin when fleet accounting is needed.
 
     Returns {op: {dtype: bytes}, "total": {dtype: bytes},
-    "float_bytes": int, "int_bytes": int} — float_bytes aggregates
-    f64/f32/bf16/f16 payloads (the compressible activation/weight wire),
-    int_bytes the id wire.
+    "float_bytes": int, "int_bytes": int}.
     """
-    import re
-    text = lowered if isinstance(lowered, str) else lowered.as_text()
-    out = {op: {} for op in collectives}
-    total: dict = {}
-    pat = re.compile(
-        r'"?stablehlo\.(' + "|".join(map(re.escape, collectives))
-        + r')"?.*?:\s*\(tensor<([^>]+)>', re.DOTALL)
-    for m in pat.finditer(text):
-        op, sig = m.group(1), m.group(2)
-        parts = sig.split("x")
-        dtype = parts[-1]
-        elems = 1
-        for p in parts[:-1]:
-            elems *= int(p)
-        nbytes = elems * _DTYPE_BYTES.get(dtype, 4)
-        out[op][dtype] = out[op].get(dtype, 0) + nbytes
-        total[dtype] = total.get(dtype, 0) + nbytes
-    float_b = sum(v for k, v in total.items()
-                  if k in ("f64", "f32", "bf16", "f16", "f8"))
-    int_b = sum(v for k, v in total.items() if k.startswith(("i", "ui")))
-    out["total"] = total
-    out["float_bytes"] = float_b
-    out["int_bytes"] = int_b
-    return out
+    from distributed_embeddings_tpu.analysis import ir
+    return ir.collective_bytes(_hlo_text(lowered), collectives)
 
 
 def hlo_collective_overlap(lowered, collectives=_COLLECTIVES,
@@ -114,187 +86,18 @@ def hlo_collective_overlap(lowered, collectives=_COLLECTIVES,
     """Classify every collective in a lowered program by its dependency
     relation to the module's dense compute — the static overlap audit
     behind the lookahead pipeline (ISSUE 9, docs/perf_model.md
-    "Lookahead prefetch").
-
-    A collective with dense compute (dot_general/convolution) in NEITHER
-    its transitive fan-in NOR its transitive fan-out is an **overlap
-    candidate**: no data dependency orders it against the dense stage,
-    so XLA's latency-hiding scheduler is free to run it concurrently
-    with the MXU work (async collective start/done pairs). In the
-    monolithic sequential step every exchange collective fails this test
-    — the forward exchange FEEDS the dense ops and the gradient
-    transpose CONSUMES them — so `overlap_candidates` is 0 there, while
-    the fused lookahead step's prefetch subgraph (batch N+1's exchange,
-    reading only params and the next batch's ids) passes it. That is
-    checkable at trace time on any backend, which makes it both the CI
-    regression gate for the pipeline structure and the attribution
-    artifact for TPU timing (tools/hlo_audit.py).
-
-    Method: the StableHLO SSA text is parsed into a per-function
-    dataflow graph; private helper functions (jax lowers shard_map
-    bodies and jnp helpers to `call @fn` sites) are summarized
-    transitively — a call-site inherits its callee's collective counts
-    and compute content — and the public entry function's graph is
-    taint-propagated in both directions. Granularity is the call SITE,
-    so a helper shared by the prefetch and drain stages is classified
-    per use, not once globally. Conservative where imprecise: a callee
-    mixing compute and collectives taints the whole call site, and
-    instructions inside nested REGIONS (stablehlo.while / case bodies,
-    e.g. a scanned multi-step program) fold into the enclosing op's
-    node — in both cases the mixed node's collectives count as
-    serialized, never as candidates.
-
-    Args:
-      lowered: ``jax.jit(f).lower(...)`` result or its ``.as_text()``.
-      collectives / compute_ops: StableHLO op mnemonics.
+    "Lookahead prefetch"). Ported onto the typed IR
+    (`analysis.ir.collective_overlap`, ISSUE 10), which owns the long
+    method docs: call-site granularity over the interprocedural
+    shmap_body call graph, conservative region folding, two-direction
+    taint.
 
     Returns {"collectives_total", "overlap_candidates",
     "serialized_collectives", "candidates_by_op", "compute_sites"}.
     """
-    import re
-    text = lowered if isinstance(lowered, str) else lowered.as_text()
-    line_re = re.compile(r'^\s*(%[\w]+)(?::\d+)?\s*=\s*(.*)$')
-    op_re = re.compile(r'"?(?:stablehlo|mhlo|chlo)\.([\w.]+)"?')
-    call_re = re.compile(r'(?:func\.)?call\s+@([\w$.-]+)')
-    func_re = re.compile(r'func\.func\s+(?:public\s+|private\s+)?'
-                         r'@([\w$.-]+)')
-
-    # Each node is one TOP-LEVEL instruction of a function. Instructions
-    # inside nested regions (stablehlo.while/case bodies) reference
-    # region block args a flat SSA graph cannot resolve, so their op
-    # kinds and operand refs FOLD INTO the enclosing op's node —
-    # conservative in the safe direction: a region mixing collectives
-    # and compute taints one node, and its collectives count as
-    # serialized, never as overlap candidates.
-    funcs: dict = {}
-    cur = None
-    depth = 0
-    for raw in text.splitlines():
-        fm = func_re.search(raw)
-        if fm:
-            cur = fm.group(1)
-            funcs[cur] = []
-            # the signature line's opening brace is the body baseline
-            depth = raw.count("{") - raw.count("}")
-            continue
-        if cur is None:
-            continue
-        at_top = depth <= 1
-        depth += raw.count("{") - raw.count("}")
-        m = line_re.match(raw)
-        if not m:
-            continue
-        lhs, rhs = m.group(1), m.group(2)
-        callee_m = call_re.search(rhs)
-        callee = callee_m.group(1) if callee_m else None
-        op_m = op_re.search(rhs)
-        op = op_m.group(1) if op_m else (
-            "call" if callee else rhs.split("(")[0].split()[0])
-        # operand refs: %N and %argN tokens on the rhs, multi-result
-        # projections (%5#1) resolve to their base value
-        operands = [t.split("#")[0] for t in
-                    re.findall(r'%[A-Za-z0-9_]+', rhs)]
-        if at_top or not funcs[cur]:
-            funcs[cur].append({"lhs": lhs, "ops": [op],
-                               "callees": [callee] if callee else [],
-                               "operands": operands})
-        else:
-            owner = funcs[cur][-1]
-            owner["ops"].append(op)
-            if callee:
-                owner["callees"].append(callee)
-            owner["operands"].extend(operands)
-
-    # ---- transitive per-function summaries (call graph is acyclic)
-    summaries: dict = {}
-
-    def summarize(fn, stack=()):
-        if fn in summaries:
-            return summaries[fn]
-        if fn not in funcs or fn in stack:
-            return {"coll": {}, "compute": False}
-        coll: dict = {}
-        compute = False
-        for node in funcs[fn]:
-            for op in node["ops"]:
-                if op in collectives:
-                    coll[op] = coll.get(op, 0) + 1
-                if op in compute_ops:
-                    compute = True
-            for callee in node["callees"]:
-                sub = summarize(callee, stack + (fn,))
-                compute = compute or sub["compute"]
-                for k, v in sub["coll"].items():
-                    coll[k] = coll.get(k, 0) + v
-        summaries[fn] = {"coll": coll, "compute": compute}
-        return summaries[fn]
-
-    entry = "main" if "main" in funcs else (
-        max(funcs, key=lambda f: len(funcs[f])) if funcs else None)
-    if entry is None:
-        return {"collectives_total": 0, "overlap_candidates": 0,
-                "serialized_collectives": 0, "candidates_by_op": {},
-                "compute_sites": 0}
-    body = funcs[entry]
-    n = len(body)
-    producer = {}
-    for i, node in enumerate(body):
-        producer[node["lhs"]] = i
-    deps = [[producer[o] for o in node["operands"] if o in producer]
-            for node in body]
-    node_coll = []
-    node_compute = []
-    for node in body:
-        c: dict = {}
-        compute = False
-        for op in node["ops"]:
-            if op in collectives:
-                c[op] = c.get(op, 0) + 1
-            if op in compute_ops:
-                compute = True
-        for callee in node["callees"]:
-            sub = summarize(callee)
-            compute = compute or sub["compute"]
-            for k, v in sub["coll"].items():
-                c[k] = c.get(k, 0) + v
-        node_coll.append(c)
-        node_compute.append(compute)
-
-    # SSA text order is topological: one forward pass taints fan-ins,
-    # one reverse pass taints fan-outs
-    dot_in_fanin = [False] * n
-    for i in range(n):
-        dot_in_fanin[i] = any(node_compute[d] or dot_in_fanin[d]
-                              for d in deps[i])
-    consumers: list = [[] for _ in range(n)]
-    for i, ds in enumerate(deps):
-        for d in ds:
-            consumers[d].append(i)
-    dot_in_fanout = [False] * n
-    for i in range(n - 1, -1, -1):
-        dot_in_fanout[i] = any(node_compute[c] or dot_in_fanout[c]
-                               for c in consumers[i])
-
-    total = 0
-    cand_by_op: dict = {}
-    candidates = 0
-    for i in range(n):
-        cnt = sum(node_coll[i].values())
-        if not cnt:
-            continue
-        total += cnt
-        # a site that itself CONTAINS compute is never a candidate (the
-        # collective may order against its own callee's dots)
-        if (not dot_in_fanin[i] and not dot_in_fanout[i]
-                and not node_compute[i]):
-            candidates += cnt
-            for k, v in node_coll[i].items():
-                cand_by_op[k] = cand_by_op.get(k, 0) + v
-    return {"collectives_total": total,
-            "overlap_candidates": candidates,
-            "serialized_collectives": total - candidates,
-            "candidates_by_op": cand_by_op,
-            "compute_sites": sum(node_compute)}
+    from distributed_embeddings_tpu.analysis import ir
+    return ir.collective_overlap(_hlo_text(lowered), collectives,
+                                 compute_ops)
 
 
 def fetch_sync(out) -> float:

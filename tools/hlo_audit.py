@@ -1,33 +1,42 @@
-"""HLO op-count and collective-byte audit for the compiled train step.
+"""Static program auditor driver: the full pass suite over the standard
+program matrix (ISSUE 10).
 
-The sort-folding work (ISSUE 2, docs/perf_model.md "Sort folding") is a
-TRACE-TIME property: the folded step must contain at most one stablehlo.sort
-per (bucket, hotness) exchange group — one more (the inverse-permute sort)
-when the tiled forward gather is active. That is checkable on any backend
-without hardware, which makes it both the regression gate for the fold and
-the attribution artifact for the day a TPU window opens: if the measured
-step is slow AND the audit says the sort count regressed, the cause is
-already isolated.
+The heavy lifting lives in `distributed_embeddings_tpu.analysis`:
+`ir` parses a lowered StableHLO module ONCE, `passes` proves the repo's
+invariants over it (sort bounds, exact collective bytes vs the
+padding-report model, overlap classification, wire-seam coverage,
+donation policy, dtype promotion, dead/duplicate collectives — run
+``--list-passes`` for the catalog, docs/analysis.md for the long form),
+and `programs` builds the audited matrix: monolithic train step (f32 +
+bf16 wire), lookahead fused + prefetch, serve forward, vocab-slack
+plan, each lowered once over an 8-virtual-device mesh and shared across
+all passes (the <=60s CI budget).
 
-The collective-byte arm (ISSUE 5, "Wire compression") applies the same
-honest-accounting pattern to the exchange WIRE: it lowers the tapped
-sparse train step over an 8-device mesh at each wire format and sums the
-`all_to_all`/`all_gather`/`reduce_scatter` operand bytes from the
-StableHLO (`utils.profiling.hlo_collective_bytes`). The bf16 wire must
-shrink the float collective bytes of the compiled step by >= 1.9x vs the
-f32 wire, and the f32 (default) wire must contain ZERO bf16 collective
-operands — both assertable without a TPU.
+This file is the thin CLI on top:
 
-Usage:
-  python tools/hlo_audit.py            # print one JSON line per arm
-  python tools/hlo_audit.py --assert   # exit 1 if any folded arm exceeds
-                                       # its sort bound, or the wire arm
-                                       # misses its byte bound (CI gate)
+  python tools/hlo_audit.py                # one JSON line per record
+  python tools/hlo_audit.py --assert      # CI gate: exit 1 on any
+                                           # finding not allowlisted in
+                                           # tools/audit_baseline.json,
+                                           # any legacy arm over bound,
+                                           # or any mutation fixture its
+                                           # pass FAILS to flag
+  python tools/hlo_audit.py --list-passes  # pass catalog
 
-Library use: ``audit_tapped_step(...)`` / ``audit_exchange_bytes(...)``
-return the counts for one configuration; bench.py embeds compact audits
-in its JSON records (``hlo_sort_audit``, ``wire_hlo``) so every hardware
-measurement carries the op-count fingerprint of the step it timed.
+The baseline (``tools/audit_baseline.json``) is a checked-in allowlist
+of ``"program:finding-id"`` strings, diffed like a snapshot — it ships
+EMPTY: every known invariant violation is a bug, not an exception. The
+mutation arm is the auditor auditing itself: for every pass, a program
+seeded with the violation it exists to catch (a naked lax.all_to_all
+around the seam, a forced f64 upcast, a self-duplicated collective, ...)
+must produce exactly the expected finding — an auditor that cannot fail
+is not a gate.
+
+Legacy per-arm records (`audit_tapped_step` sort gates at 30M-row
+vocabs/tiled/hot shards, `wire_byte_arms`, `audit_lookahead_overlap`)
+still run and still gate: bench.py embeds them in every hardware record
+so each measurement carries the op-count fingerprint of the step it
+timed.
 """
 
 import argparse
@@ -37,289 +46,21 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from distributed_embeddings_tpu.analysis import programs as _programs  # noqa: E402
+from distributed_embeddings_tpu.analysis import ir, passes  # noqa: E402
 
-def _build_model(vocab: int, width: int, combiner: str, hot_rows: int = 0,
-                 tables: int = 1, mesh=None, exchange_wire=None,
-                 dense_head: bool = False):
-    """Minimal tapped model (the shape make_sparse_train_step expects)
-    around a DistributedEmbedding — THE one copy of this harness, shared
-    by the sort-count arms, the collective-byte wire arms, the lookahead
-    overlap arm, and bench.py's --mode wire / --mode lookahead A/Bs (via
-    _load_hlo_audit), so the audit and the bench always lower the same
-    program.
+# bench.py and the test suite reach these by their historical names
+_build_model = _programs.build_model
+_head_params = _programs.head_params
+_ensure_world = _programs.ensure_world
+audit_tapped_step = _programs.audit_tapped_step
+audit_exchange_bytes = _programs.audit_exchange_bytes
+audit_lookahead_overlap = _programs.audit_lookahead_overlap
+wire_byte_arms = _programs.wire_byte_arms
+WIRE_BYTE_MIN_REDUCTION = _programs.WIRE_BYTE_MIN_REDUCTION
 
-    ``dense_head=True`` puts a real matmul between the embedding outputs
-    and the loss (params gain a ``head`` kernel, built by
-    ``_head_params``). The lookahead overlap audit classifies collectives
-    by dependency on dot ops — without a dot in the module the metric is
-    vacuous — and a dense head is what the pipeline overlaps against in
-    the first place."""
-    import jax.numpy as jnp
-    from distributed_embeddings_tpu.layers.dist_model_parallel import (
-        DistributedEmbedding)
-    from distributed_embeddings_tpu.layers.embedding import Embedding
-
-    class _Tapped:
-        def __init__(self, emb):
-            self.embedding = emb
-
-        def loss_fn(self, p, numerical, cats, labels, taps=None,
-                    return_residuals=False):
-            out = self.embedding(p["embedding"], list(cats), taps=taps,
-                                 return_residuals=return_residuals)
-            outs, res = out if return_residuals else (out, None)
-            x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs],
-                                axis=1)
-            if dense_head:
-                pred = (x.astype(jnp.float32) @ p["head"])[:, 0]
-            else:
-                pred = jnp.sum(x, axis=1)
-            loss = jnp.mean((pred - labels.reshape(-1)) ** 2)
-            return (loss, res) if return_residuals else loss
-
-    emb = DistributedEmbedding(
-        [Embedding(vocab, width, combiner=combiner) for _ in range(tables)],
-        mesh=mesh, hot_rows=hot_rows, exchange_wire=exchange_wire)
-    return _Tapped(emb)
-
-
-def _head_params(tables: int, width: int, hotness: int, combiner: str):
-    """The replicated dense-head kernel matching _build_model's
-    ``dense_head=True`` loss (one output column)."""
-    import jax.numpy as jnp
-    per = width * (1 if combiner else hotness)
-    return jnp.zeros((tables * per, 1), jnp.float32)
-
-
-def audit_tapped_step(vocab: int = 30_000_000, width: int = 8,
-                      batch: int = 8, hotness: int = 4,
-                      optimizer: str = "adagrad", strategy: str = "sort",
-                      lookup_path: str = None, fold: bool = True,
-                      combiner: str = "sum", hot_rows: int = 0) -> dict:
-    """Lower one tapped sparse train step (abstract avals — no giant table
-    is materialized) and count its StableHLO ops. Returns the counts plus
-    the exchange-group count the sort bound is measured against.
-
-    ``hot_rows > 0`` lowers the hot-row-replication step (ISSUE 4): the
-    membership split is a searchsorted (binary search) and the replicated
-    hot update is a dense scatter — the sort BOUND is identical to the
-    hot-less step, which is exactly the acceptance gate ("the hot split
-    adds zero sort instructions per exchange group")."""
-    import jax
-    import jax.numpy as jnp
-    from distributed_embeddings_tpu.training import make_sparse_train_step
-    from distributed_embeddings_tpu.utils.profiling import hlo_op_counts
-
-    prev = os.environ.get("DET_LOOKUP_PATH")
-    try:
-        if lookup_path is None:
-            os.environ.pop("DET_LOOKUP_PATH", None)
-        else:
-            os.environ["DET_LOOKUP_PATH"] = lookup_path
-        model = _build_model(vocab, width, combiner, hot_rows=hot_rows)
-        emb = model.embedding
-        init_fn, step_fn = make_sparse_train_step(
-            model, optimizer, lr=0.01, strategy=strategy, fold_sort=fold)
-        params = jax.eval_shape(
-            lambda: {"embedding": emb.init(jax.random.PRNGKey(0))})
-        state = jax.eval_shape(init_fn, params)
-        num = jax.ShapeDtypeStruct((batch, 1), jnp.float32)
-        cats = [jax.ShapeDtypeStruct((batch, hotness), jnp.int32)]
-        lab = jax.ShapeDtypeStruct((batch,), jnp.float32)
-        lowered = jax.jit(step_fn).lower(params, state, num, cats, lab)
-        counts = hlo_op_counts(lowered)
-        key = ((hotness, False),)
-        groups, _ = emb._exchange_groups_for_key(key)
-        n_groups = len(groups)
-    finally:
-        if prev is None:
-            os.environ.pop("DET_LOOKUP_PATH", None)
-        else:
-            os.environ["DET_LOOKUP_PATH"] = prev
-    # the bound the fold ships under: one canonical sort per exchange
-    # group, plus the tiled forward gather's inverse-permute sort (the one
-    # residual sort — scatter-free inversion needs a second sort op)
-    bound = n_groups * (2 if lookup_path == "tiled" else 1)
-    return {
-        "optimizer": optimizer, "strategy": strategy,
-        "lookup_path": lookup_path or "default", "fold": fold,
-        "hot_rows": hot_rows,
-        "n_exchange_groups": n_groups, "sort_bound": bound,
-        **{f"hlo_{k}": v for k, v in counts.items()},
-    }
-
-
-def _ensure_world(n: int = 8) -> int:
-    """Request >= n virtual CPU devices (the wire-byte arms lower real
-    collectives, which a world-1 model never emits). Must run before the
-    backend initializes; returns the device count actually available."""
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n}").strip()
-    import jax
-    try:
-        jax.config.update("jax_num_cpu_devices", n)
-    except Exception:  # noqa: BLE001 - backend already up / older jax
-        pass
-    return len(jax.devices())
-
-
-def audit_exchange_bytes(wire: str = "f32", vocab: int = 4096,
-                         width: int = 32, tables: int = 8, batch: int = 16,
-                         hotness: int = 2, optimizer: str = "adagrad",
-                         world: int = 8) -> dict:
-    """Lower the tapped sparse train step over a `world`-device mesh at
-    one exchange-wire format and return its collective-byte accounting
-    (plus the per-group padding-report byte fields, so the static claim
-    and the compiled HLO can be cross-checked in one record)."""
-    import jax
-    import jax.numpy as jnp
-    from distributed_embeddings_tpu.parallel.mesh import create_mesh
-    from distributed_embeddings_tpu.training import make_sparse_train_step
-    from distributed_embeddings_tpu.utils.profiling import (
-        hlo_collective_bytes, hlo_op_counts)
-
-    devs = jax.devices()
-    if len(devs) < world:
-        return {"wire": wire, "skipped":
-                f"need {world} devices for the meshed lowering, "
-                f"have {len(devs)}"}
-    mesh = create_mesh(devs[:world])
-    model = _build_model(vocab, width, "sum", tables=tables, mesh=mesh,
-                         exchange_wire=wire)
-    emb = model.embedding
-    init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.01)
-    params = {"embedding": emb.init(jax.random.PRNGKey(0))}
-    state = init_fn(params)
-    num = jnp.zeros((batch, 1), jnp.float32)
-    cats = [jnp.zeros((batch, hotness), jnp.int32) for _ in range(tables)]
-    lab = jnp.zeros((batch,), jnp.float32)
-    lowered = jax.jit(step_fn).lower(params, state, num, cats, lab)
-    text = lowered.as_text()
-    bytes_ = hlo_collective_bytes(text)
-    rep = emb.exchange_padding_report(hotness=[hotness] * tables)
-    return {
-        "wire": wire, "optimizer": optimizer, "world": world,
-        "vocab": vocab, "width": width, "tables": tables, "batch": batch,
-        "hotness": hotness,
-        "collective_float_bytes": bytes_["float_bytes"],
-        "collective_int_bytes": bytes_["int_bytes"],
-        "collective_bytes_by_dtype": bytes_["total"],
-        "report_act_bytes": rep["act_bytes"],
-        "report_act_bytes_f32": rep["act_bytes_f32"],
-        "report_act_wire_reduction": round(rep["act_wire_reduction"], 3),
-        "report_exchanged_bytes": rep["exchanged_bytes"],
-        "report_true_bytes": rep["true_bytes"],
-        "id_narrowed_groups": rep["id_narrowed_groups"],
-        **{f"hlo_{k}": v for k, v in hlo_op_counts(text).items()},
-    }
-
-
-def audit_lookahead_overlap(vocab: int = 4096, width: int = 32,
-                            tables: int = 4, batch: int = 64,
-                            hotness: int = 2, optimizer: str = "adagrad",
-                            world: int = 8, stale_ok: bool = False) -> dict:
-    """Lower the lookahead engine's FUSED staged step over a
-    `world`-device mesh and prove, on the dependency graph of the
-    StableHLO, that batch N+1's exchange collectives carry NO data
-    dependency on batch N's dense compute (ISSUE 9) — the static twin of
-    an ICI/MXU overlap measurement, checkable without hardware.
-
-    Three lowerings, one record:
-      * the fused step — its `overlap_candidates` (collectives with dot
-        ops on neither side, see profiling.hlo_collective_overlap) must
-        cover the whole prefetch stage;
-      * the standalone prefetch executable — defines how many
-        collectives that stage contains;
-      * the monolithic baseline step — must audit to ZERO candidates
-        (every exchange is on the dense critical path there), which
-        keeps the metric itself honest, and pins the sort bound: the
-        fused step must lower with NO extra stablehlo.sort ops vs the
-        monolithic step (the PR 2 gate carried over — the patch arm is a
-        sort-free plain recompute).
-    """
-    import jax
-    import jax.numpy as jnp
-    from distributed_embeddings_tpu.parallel.mesh import create_mesh
-    from distributed_embeddings_tpu.schedule import LookaheadEngine
-    from distributed_embeddings_tpu.training import make_sparse_train_step
-    from distributed_embeddings_tpu.utils.profiling import (
-        hlo_collective_overlap, hlo_op_counts)
-
-    devs = jax.devices()
-    if len(devs) < world:
-        return {"arm": "lookahead_overlap", "skipped":
-                f"need {world} devices for the meshed lowering, "
-                f"have {len(devs)}"}
-    mesh = create_mesh(devs[:world])
-    model = _build_model(vocab, width, "sum", tables=tables, mesh=mesh,
-                         dense_head=True)
-    emb = model.embedding
-    params = {"embedding": emb.init(jax.random.PRNGKey(0)),
-              "head": _head_params(tables, width, hotness, "sum")}
-    engine = LookaheadEngine(model, optimizer, lr=0.01,
-                             stale_ok=stale_ok, donate=False)
-    state = engine.init(params)
-    num = jnp.zeros((batch, 1), jnp.float32)
-    cats = [jnp.zeros((batch, hotness), jnp.int32) for _ in range(tables)]
-    lab = jnp.zeros((batch,), jnp.float32)
-    b0 = (num, cats, lab)
-
-    fused_txt = engine.lower_fused(params, state, b0, b0).as_text()
-    pre_txt = engine.lower_prefetch(params, cats).as_text()
-    init2, step2 = make_sparse_train_step(model, optimizer, lr=0.01,
-                                          donate=False)
-    base_txt = jax.jit(step2).lower(params, init2(params), num, cats,
-                                    lab).as_text()
-
-    fused_ov = hlo_collective_overlap(fused_txt)
-    pre_ov = hlo_collective_overlap(pre_txt)
-    base_ov = hlo_collective_overlap(base_txt)
-    fused_sorts = hlo_op_counts(fused_txt)["sort"]
-    base_sorts = hlo_op_counts(base_txt)["sort"]
-    rec = {
-        "arm": "lookahead_overlap", "optimizer": optimizer,
-        "world": world, "vocab": vocab, "width": width, "tables": tables,
-        "batch": batch, "hotness": hotness, "stale_ok": stale_ok,
-        "fused_collectives": fused_ov["collectives_total"],
-        "fused_overlap_candidates": fused_ov["overlap_candidates"],
-        "fused_candidates_by_op": fused_ov["candidates_by_op"],
-        "prefetch_collectives": pre_ov["collectives_total"],
-        "baseline_collectives": base_ov["collectives_total"],
-        "baseline_overlap_candidates": base_ov["overlap_candidates"],
-        "fused_sorts": fused_sorts, "baseline_sorts": base_sorts,
-        "extra_sorts": fused_sorts - base_sorts,
-    }
-    rec["over_bound"] = bool(
-        rec["prefetch_collectives"] == 0
-        or rec["fused_overlap_candidates"] < rec["prefetch_collectives"]
-        or rec["baseline_overlap_candidates"] != 0
-        or rec["extra_sorts"] > 0)
-    return rec
-
-
-# minimum float-collective-byte shrink the bf16 wire must show vs f32 on
-# the same lowered step — the wire moves half the bits, so the compiled
-# ratio is 2.0 minus whatever small float traffic is not behind the seam
-WIRE_BYTE_MIN_REDUCTION = 1.9
-
-
-def wire_byte_arms(**kw) -> list:
-    """The f32-vs-bf16 collective-byte A/B records (+ derived reduction
-    stamped on the bf16 record)."""
-    base = audit_exchange_bytes(wire="f32", **kw)
-    comp = audit_exchange_bytes(wire="bf16", **kw)
-    if "skipped" not in comp and "skipped" not in base:
-        fb = base["collective_float_bytes"]
-        cb = comp["collective_float_bytes"]
-        comp["float_bytes_reduction_vs_f32"] = (
-            round(fb / cb, 3) if cb else None)
-        comp["min_reduction_required"] = WIRE_BYTE_MIN_REDUCTION
-        base["bf16_collective_bytes"] = (
-            base["collective_bytes_by_dtype"].get("bf16", 0))
-    return [base, comp]
-
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "audit_baseline.json")
 
 DEFAULT_ARMS = (
     # (optimizer, strategy, lookup_path, hot_rows)
@@ -336,10 +77,62 @@ DEFAULT_ARMS = (
 )
 
 
+def load_baseline(path: str = BASELINE_PATH) -> set:
+    """The allowlist: a set of "program:finding-id" strings."""
+    try:
+        with open(path) as f:
+            return set(json.load(f).get("allow", []))
+    except FileNotFoundError:
+        return set()
+
+
+def run_matrix(baseline: set, **kw) -> tuple:
+    """Lower the program matrix once, run every applicable pass on each
+    parsed module; returns (records, failures) where a failure is any
+    finding whose "program:fid" key is not allowlisted."""
+    records, failures = [], []
+    for prog in _programs.program_matrix(**kw):
+        names = [n for n in passes.PASS_REGISTRY
+                 if n not in prog.skip_passes]
+        findings = passes.run_passes(prog.module, prog.ctx, passes=names)
+        rec = {"program": prog.name, "passes_run": len(names),
+               "findings": [f.to_dict() for f in findings]}
+        for f in findings:
+            key = f"{prog.name}:{f.fid}"
+            if key not in baseline:
+                failures.append({"program": prog.name, **f.to_dict()})
+        records.append(rec)
+    return records, failures
+
+
+def run_mutations() -> tuple:
+    """Every pass must FLAG its seeded violation — a mutation that does
+    NOT produce exactly its expected findings is itself a failure (the
+    gate went blind)."""
+    records, failures = [], []
+    for case in _programs.mutation_cases():
+        mod = ir.parse_module(case.text)
+        got = tuple(f.fid for f in passes.run_passes(
+            mod, case.ctx, passes=[case.pass_name]))
+        ok = got == case.expect_fids
+        rec = {"mutation": case.name, "pass": case.pass_name,
+               "expected_findings": list(case.expect_fids),
+               "got_findings": list(got), "flagged": ok}
+        records.append(rec)
+        if not ok:
+            failures.append(rec)
+    return records, failures
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--assert", dest="do_assert", action="store_true",
-                   help="exit 1 when a folded arm exceeds its sort bound")
+                   help="exit 1 on any non-allowlisted finding, legacy "
+                        "arm over bound, or unflagged mutation")
+    p.add_argument("--list-passes", action="store_true",
+                   help="print the pass catalog and exit")
+    p.add_argument("--baseline", default=BASELINE_PATH,
+                   help="allowlist JSON (default tools/audit_baseline.json)")
     p.add_argument("--vocab", type=int, default=30_000_000)
     p.add_argument("--width", type=int, default=8)
     p.add_argument("--unfolded", action="store_true",
@@ -348,16 +141,27 @@ def main(argv=None) -> int:
                    help="skip the meshed collective-byte wire arms")
     p.add_argument("--skip-lookahead", action="store_true",
                    help="skip the meshed lookahead overlap arm")
+    p.add_argument("--skip-matrix", action="store_true",
+                   help="skip the pass-framework program matrix")
+    p.add_argument("--skip-mutations", action="store_true",
+                   help="skip the mutation-fixture self-check")
     args = p.parse_args(argv)
+
+    if args.list_passes:
+        for name, doc in passes.list_passes():
+            print(f"{name:22s} {doc}")
+        return 0
 
     import jax
     jax.config.update("jax_platforms",
                       os.environ.get("JAX_PLATFORMS") or "cpu")
-    # the wire-byte and lookahead arms lower over an 8-device mesh;
-    # virtual devices must be requested BEFORE the first backend touch
-    if not (args.skip_wire and args.skip_lookahead):
+    # meshed lowerings need the virtual world BEFORE the backend wakes
+    if not (args.skip_wire and args.skip_lookahead and args.skip_matrix
+            and args.skip_mutations):
         _ensure_world(8)
     failures = []
+
+    # ---- legacy per-arm sort gates (bench.py embeds the same records)
     for optimizer, strategy, lookup, hot_rows in DEFAULT_ARMS:
         folds = (True, False) if args.unfolded else (True,)
         for fold in folds:
@@ -369,15 +173,14 @@ def main(argv=None) -> int:
                 rec["over_bound"] = True
                 failures.append(rec)
             print(json.dumps(rec), flush=True)
+
+    # ---- legacy wire byte arms (ratio + zero-bf16 contract)
     if not args.skip_wire:
         arms = wire_byte_arms()
         for rec in arms:
             print(json.dumps(rec), flush=True)
         base, comp = arms
         if "skipped" not in comp:
-            # the f32 default must move ZERO bf16 collective bytes (the
-            # bit-exactness contract) and the bf16 wire must shrink the
-            # float collective bytes of the SAME step by >= 1.9x
             if base.get("bf16_collective_bytes"):
                 base["over_bound"] = True
                 failures.append(base)
@@ -385,19 +188,38 @@ def main(argv=None) -> int:
             if red is None or red < WIRE_BYTE_MIN_REDUCTION:
                 comp["over_bound"] = True
                 failures.append(comp)
+
+    # ---- legacy lookahead overlap arm
     if not args.skip_lookahead:
-        # lookahead overlap arm (ISSUE 9): the fused staged step's
-        # prefetch collectives must be dependency-free of the dense
-        # compute (overlap candidates >= the whole prefetch stage), the
-        # monolithic baseline must audit to zero candidates, and the
-        # fused lowering must add ZERO sort ops vs the baseline
         rec = audit_lookahead_overlap()
         print(json.dumps(rec), flush=True)
         if "skipped" not in rec and rec.get("over_bound"):
             failures.append(rec)
+
+    # ---- the pass-framework matrix (ISSUE 10)
+    if not args.skip_matrix:
+        baseline = load_baseline(args.baseline)
+        records, fs = run_matrix(baseline)
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        failures.extend(fs)
+
+    # ---- mutation self-check: every pass must flag its seeded violation
+    if not args.skip_mutations:
+        records, fs = run_mutations()
+        print(json.dumps({
+            "mutations_total": len(records),
+            "mutations_flagged": sum(r["flagged"] for r in records),
+            "unflagged": [r for r in records if not r["flagged"]],
+        }), flush=True)
+        failures.extend(fs)
+
     if args.do_assert and failures:
-        print(f"hlo_audit: {len(failures)} arm(s) exceed their bound "
-              "(sort count or collective bytes)", file=sys.stderr)
+        print(f"hlo_audit: {len(failures)} failure(s) — non-allowlisted "
+              "findings, arms over bound, or blind mutation gates",
+              file=sys.stderr)
+        for f in failures:
+            print(f"  {json.dumps(f)[:300]}", file=sys.stderr)
         return 1
     return 0
 
