@@ -425,13 +425,20 @@ def run_serve_bench(requests: int = 128, batch: int = 64,
             [Embedding(v, w, combiner="sum") for v, w in specs],
             gpu_embedding_size=16 * 1024)
 
+    from distributed_embeddings_tpu.obs import default_registry
+    obs_reg = default_registry()
     dist = build()
     if not dist._offload_enabled:
         return {"serve_error": "backend exposes no host memory space"}
     params = dist.init(jax.random.PRNGKey(seed))
     engine = InferenceEngine(dist, params, cache_capacity=capacity,
-                             promote_threshold=promote_threshold)
+                             promote_threshold=promote_threshold,
+                             registry=obs_reg)
     engine.warmup([batch])
+    # warm-up batcher on a PRIVATE registry: the measurement batcher
+    # below shares obs_reg's serve/request_seconds histogram, and the
+    # cold-compile warm-up latencies must not enter the headline
+    # percentiles (the reason the batcher is rebuilt at all)
     batcher = MicroBatcher(engine, max_batch=batch)
     samplers = [zipf_sampler(v, alpha, rng) for v, _ in specs]
 
@@ -468,7 +475,7 @@ def run_serve_bench(requests: int = 128, batch: int = 64,
                                                   lr=0.05)
         t_state = init_fn(t_params)
         pub_store = TableStore(t_dist, t_params["embedding"],
-                               t_state["emb"])
+                               t_state["emb"], registry=obs_reg)
         pub_dir = tempfile.mkdtemp(prefix="det_stream_")
         t_rng = np.random.RandomState(seed + 1)
         t_samplers = [zipf_sampler(v, alpha, t_rng) for v, _ in specs]
@@ -512,7 +519,7 @@ def run_serve_bench(requests: int = 128, batch: int = 64,
     for _ in range(4):
         batcher.submit(request()[0])
     batcher.flush()
-    batcher = MicroBatcher(engine, max_batch=batch)
+    batcher = MicroBatcher(engine, max_batch=batch, registry=obs_reg)
     # steady-state hit rate: measure against a post-warm-up baseline so the
     # cold-start misses of the warm-up stream don't dilute the headline
     base = engine.cache_stats()
@@ -637,7 +644,7 @@ def serve_main(argv=None) -> int:
         alpha=args.alpha, promote_threshold=args.promote_threshold,
         seed=args.seed, updater_steps=args.updater_steps,
         publish_every=args.publish_every, train_batch=args.train_batch)
-    print(json.dumps(_stamp_audit_findings(record)))
+    print(json.dumps(_stamp_metrics_snapshot(_stamp_audit_findings(record))))
     return 0 if "serve_error" not in record else 1
 
 
@@ -685,6 +692,35 @@ def _stamp_audit_findings(record: dict) -> dict:
                                     "world": world}
     except Exception as e:  # noqa: BLE001 - audit must not kill bench
         record["audit_findings"] = {"error": str(e)[:200]}
+    return record
+
+
+def _stamp_metrics_snapshot(record: dict) -> dict:
+    """Stamp the process-default `obs.MetricRegistry` snapshot onto a
+    bench record before it is emitted (ISSUE 11): every mode wires its
+    components (engine, batcher, store, vocab manager, lookahead
+    engine, merged ingest histograms) onto `obs.default_registry()`, so
+    ``metrics_snapshot`` carries the run's full telemetry next to
+    ``audit_findings``. With ``DET_SLO_RULES=<file>`` the snapshot is
+    additionally evaluated against the checked-in SLO rules and the
+    findings land as ``slo_findings`` ({"count", "ids"} — the
+    audit-findings shape, gated the same way). Never raises."""
+    try:
+        from distributed_embeddings_tpu.obs import registry as obs_registry
+        record["metrics_snapshot"] = obs_registry.default_registry(
+        ).snapshot()
+    except Exception as e:  # noqa: BLE001 - telemetry must not kill bench
+        record["metrics_snapshot"] = {"error": str(e)[:200]}
+        return record
+    rules_path = os.environ.get("DET_SLO_RULES")
+    if rules_path:
+        try:
+            from distributed_embeddings_tpu.obs import slo
+            record["slo_findings"] = slo.summarize(slo.evaluate_rules(
+                slo.load_rules(rules_path), record["metrics_snapshot"]))
+        except Exception as e:  # noqa: BLE001 - a bad rule FILE is an
+            # error stamp, never a lost snapshot
+            record["slo_findings"] = {"error": str(e)[:200]}
     return record
 
 
@@ -870,7 +906,7 @@ def hotrows_main(argv=None) -> int:
         traceback.print_exc()
         record = {"metric": "hotrows_zipf_train_ab",
                   "hotrows_error": str(e)[:300], "git_sha": _git_sha()}
-    print(json.dumps(_stamp_audit_findings(record)))
+    print(json.dumps(_stamp_metrics_snapshot(_stamp_audit_findings(record))))
     return 0 if "hotrows_error" not in record else 1
 
 
@@ -922,8 +958,10 @@ def run_vocab_bench(steps: int = 64, batch: int = 4096, tables: int = 4,
                              - labels.reshape(-1)) ** 2)
             return (loss, res) if return_residuals else loss
 
+    from distributed_embeddings_tpu.obs import default_registry
     model = _M()
-    mgr = VocabManager(emb, admit_threshold=admit_threshold, decay=decay)
+    mgr = VocabManager(emb, admit_threshold=admit_threshold, decay=decay,
+                       registry=default_registry())
     init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.05)
     params = {"embedding": emb.init(jax.random.PRNGKey(seed))}
     state = init_fn(params)
@@ -1048,7 +1086,7 @@ def vocab_main(argv=None) -> int:
         traceback.print_exc()
         record = {"metric": "vocab_zipf_drift_admission",
                   "vocab_error": str(e)[:300], "git_sha": _git_sha()}
-    print(json.dumps(_stamp_audit_findings(record)))
+    print(json.dumps(_stamp_metrics_snapshot(_stamp_audit_findings(record))))
     return 0 if "vocab_error" not in record else 1
 
 
@@ -1192,7 +1230,7 @@ def wire_main(argv=None) -> int:
         traceback.print_exc()
         record = {"metric": "wire_exchange_train_ab",
                   "wire_error": str(e)[:300], "git_sha": _git_sha()}
-    print(json.dumps(_stamp_audit_findings(record)))
+    print(json.dumps(_stamp_metrics_snapshot(_stamp_audit_findings(record))))
     return 0 if "wire_error" not in record else 1
 
 
@@ -1284,9 +1322,11 @@ def run_lookahead_bench(vocab: int = 100_000, width: int = 64,
         num, cats, lab = batches[i % nb]
         p, s, loss = step_fn(p, s, num, list(cats), lab)
         mono_losses.append(float(loss))
+    from distributed_embeddings_tpu.obs import default_registry
     engine = LookaheadEngine(model, optimizer, lr=0.01,
                              patch_capacity=patch_capacity,
-                             stale_ok=stale_ok)
+                             stale_ok=stale_ok,
+                             registry=default_registry())
     p2 = build_params(model)
     s2 = engine.init(p2)
     eng_losses = []
@@ -1320,7 +1360,8 @@ def run_lookahead_bench(vocab: int = 100_000, width: int = 64,
 
     eng_t = LookaheadEngine(model, optimizer, lr=0.01,
                             patch_capacity=patch_capacity,
-                            stale_ok=stale_ok)
+                            stale_ok=stale_ok,
+                            registry=default_registry())
     pe = build_params(model)
     se = eng_t.init(pe)
 
@@ -1419,7 +1460,7 @@ def lookahead_main(argv=None) -> int:
         traceback.print_exc()
         record = {"metric": "lookahead_train_ab",
                   "lookahead_error": str(e)[:300], "git_sha": _git_sha()}
-    print(json.dumps(_stamp_audit_findings(record)))
+    print(json.dumps(_stamp_metrics_snapshot(_stamp_audit_findings(record))))
     return 0 if "lookahead_error" not in record else 1
 
 
@@ -1615,6 +1656,18 @@ def run_ingest_bench(batches: int = 32, batch: int = 16384,
                             > results[label]["samples_per_sec"]):
                         results[label] = res
 
+            # all-reps aggregates onto the process-default registry so
+            # the record's metrics_snapshot (ISSUE 11) carries the same
+            # distributions as ingest_stage_summary_all_reps — the
+            # per-rep pipelines keep their private per-instance
+            # registries (the A/B arms must not share instruments)
+            from distributed_embeddings_tpu.obs import default_registry
+            obs_reg = default_registry()
+            for arm_label, hs in agg_hists.items():
+                for sname, h in hs.items():
+                    obs_reg.histogram("ingest/stage_seconds_all_reps",
+                                      arm=arm_label, stage=sname).merge(h)
+
             ser = results["serial"]["samples_per_sec"]
             pip = results["pipelined"]["samples_per_sec"]
             pip_stage_ms = results["pipelined"]["stage_ms"]
@@ -1693,7 +1746,7 @@ def ingest_main(argv=None) -> int:
         traceback.print_exc()
         record = {"metric": "ingest_serial_vs_pipelined_powerlaw",
                   "ingest_error": str(e)[:300], "git_sha": _git_sha()}
-    print(json.dumps(_stamp_audit_findings(record)))
+    print(json.dumps(_stamp_metrics_snapshot(_stamp_audit_findings(record))))
     return 0 if "ingest_error" not in record else 1
 
 
@@ -2137,7 +2190,7 @@ def main():
             _maybe_write_measured_defaults(record)
         except Exception as e:  # noqa: BLE001 - self-tuning must not kill it
             record["measured_defaults_error"] = str(e)[:200]
-        print(json.dumps(_stamp_audit_findings(record)))
+        print(json.dumps(_stamp_metrics_snapshot(_stamp_audit_findings(record))))
         if jax.devices()[0].platform != "cpu":
             try:
                 record["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",

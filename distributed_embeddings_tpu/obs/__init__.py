@@ -1,0 +1,33 @@
+"""Unified runtime telemetry (ISSUE 11): one metric registry, host-side
+step-span tracing, and declarative SLO evaluation across
+train/serve/vocab/store/lookahead.
+
+See docs/observability.md for the full API and schema; the short form:
+
+    from distributed_embeddings_tpu import obs
+
+    reg = obs.MetricRegistry()            # or obs.default_registry()
+    reg.counter("train/steps").inc()
+    with obs.span("train/step", reg):
+        ...
+    snap = reg.snapshot()
+    findings = obs.evaluate_rules(obs.load_rules("slo.json"), snap)
+"""
+
+from distributed_embeddings_tpu.obs.registry import (  # noqa: F401
+    Counter, Gauge, LatencyHistogram, MetricRegistry, default_registry,
+    metric_key, reset_default_registry)
+from distributed_embeddings_tpu.obs.slo import (  # noqa: F401
+    evaluate_rules, load_rules, metric_value, summarize)
+from distributed_embeddings_tpu.obs.spans import (  # noqa: F401
+    annotation, current_span, span)
+from distributed_embeddings_tpu.obs.instrument import (  # noqa: F401
+    export_exchange_gauges)
+
+__all__ = [
+    "Counter", "Gauge", "LatencyHistogram", "MetricRegistry",
+    "default_registry", "reset_default_registry", "metric_key",
+    "span", "annotation", "current_span",
+    "load_rules", "evaluate_rules", "metric_value", "summarize",
+    "export_exchange_gauges",
+]

@@ -1,0 +1,55 @@
+"""Bridging helpers: existing accounting surfaces -> registry (ISSUE 11).
+
+The padding/byte/touched-row report (`DistributedEmbedding.
+exchange_padding_report`) is the repo's static model of every per-step
+volume; `export_exchange_gauges` publishes its headline fields as
+registry gauges so SLO rules and bench snapshots address them the same
+way they address runtime counters — and so the consistency test
+(tests/test_exchange.py) can assert the gauges a driven run exported
+EQUAL a fresh report's fields (the wiring, not the model, is what can
+silently rot).
+"""
+
+from typing import Optional
+
+from distributed_embeddings_tpu.obs.registry import MetricRegistry
+
+__all__ = ["export_exchange_gauges", "EXCHANGE_GAUGE_FIELDS",
+           "EXCHANGE_GROUP_GAUGE_FIELDS"]
+
+# top-level report fields exported as exchange/<field> gauges
+EXCHANGE_GAUGE_FIELDS = (
+    "true_ids", "exchanged_ids", "ratio",
+    "exchanged_bytes", "true_bytes", "act_wire_reduction",
+    "touched_rows_per_step", "delta_bytes_per_step",
+    "occupancy", "slack_rows", "evictions_per_step",
+    "prefetch_patch_rows_per_step", "prefetch_patch_bytes_per_step",
+)
+
+# per-group fields exported with a group= label
+EXCHANGE_GROUP_GAUGE_FIELDS = (
+    "touched_rows_per_step", "occupancy",
+    "prefetch_patch_rows_per_step",
+)
+
+
+def export_exchange_gauges(registry: MetricRegistry, emb, *,
+                           batch: int = 1, vocab=None, lookahead: int = 0,
+                           hot_hit_rate=None,
+                           hotness: Optional[list] = None) -> dict:
+    """Set ``exchange/*`` gauges from one `exchange_padding_report`
+    call (same arguments, same numbers); per-group entries land under a
+    ``group=<index>`` label with the bucket index alongside. Returns
+    the report so callers embedding it (bench records, fit history)
+    don't recompute it."""
+    rep = emb.exchange_padding_report(hotness=hotness,
+                                      hot_hit_rate=hot_hit_rate,
+                                      batch=batch, vocab=vocab,
+                                      lookahead=lookahead)
+    for field in EXCHANGE_GAUGE_FIELDS:
+        registry.gauge(f"exchange/{field}").set(rep[field])
+    for gi, entry in enumerate(rep["groups"]):
+        for field in EXCHANGE_GROUP_GAUGE_FIELDS:
+            registry.gauge(f"exchange/{field}", group=gi,
+                           bucket=entry["bucket"]).set(entry[field])
+    return rep

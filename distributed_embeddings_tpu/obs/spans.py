@@ -1,0 +1,100 @@
+"""Host-side step-span tracing (ISSUE 11).
+
+``span("train/step")`` times a host-side region into a registry
+histogram AND opens a ``jax.profiler.TraceAnnotation`` for the same
+region, so the spans that structure a training/serving loop show up in
+two places at once: the registry snapshot (wall-time percentiles per
+span path, SLO-gateable) and the XPlane trace (TensorBoard/Perfetto,
+next to the device ops the span dispatched).
+
+Nesting composes paths: a ``span("publish")`` opened inside
+``span("train")`` records as ``train/publish`` — the per-thread span
+stack supplies the prefix, so instrumented helpers don't need to know
+where they are called from. The stack is thread-local: pipeline worker
+threads and the consumer each get their own nesting.
+
+This module is HOST-side by design: spans read the wall clock, which is
+exactly what `tools/lint_invariants.py`'s ``wallclock-in-jit`` rule
+bans from jitted-code modules (ops/, layers/, parallel/, schedule/).
+``obs/`` is deliberately NOT in that module set — it is the sanctioned
+home for wall-clock accounting — and instrumented call sites in jitted
+modules must stay in their host-side driver methods (e.g.
+`LookaheadEngine.step`'s Python body, never inside a traced function:
+a traced span would freeze one timestamp into the compiled program and
+time nothing).
+
+The `annotation()` helper is the shared tolerant wrapper around
+`utils.profiling.annotate`: the works/doesn't-work probe is cached
+module-wide, so backends with no profiler configured pay one failed
+construction per process instead of one exception per region
+(`utils.pipeline` delegates here — its per-stage-invocation re-probe
+was measurable ingest overhead).
+"""
+
+import contextlib
+import threading
+import time
+from typing import Optional
+
+from distributed_embeddings_tpu.obs.registry import (MetricRegistry,
+                                                     default_registry)
+
+__all__ = ["span", "annotation", "current_span"]
+
+_state = threading.local()
+
+# cached annotate probe: None = untried, False = profiler unavailable
+# (never retried), True = construction known to work
+_ANNOTATE_OK = None
+
+
+def annotation(name: str):
+    """`utils.profiling.annotate(name)`, tolerating backends with no
+    profiler — the probe result is cached process-wide so the failure
+    path costs one exception total, not one per region."""
+    global _ANNOTATE_OK
+    if _ANNOTATE_OK is False:
+        return contextlib.nullcontext()
+    from distributed_embeddings_tpu.utils import profiling
+    try:
+        cm = profiling.annotate(name)
+        _ANNOTATE_OK = True
+        return cm
+    except Exception:  # noqa: BLE001 - accounting must never break the run
+        _ANNOTATE_OK = False
+        return contextlib.nullcontext()
+
+
+def current_span() -> Optional[str]:
+    """The innermost open span path on this thread (None outside any)."""
+    stack = getattr(_state, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def span(name: str, registry: Optional[MetricRegistry] = None):
+    """Time a host-side region into ``span_seconds{span=<path>}``.
+
+    Args:
+      name: span name; joined onto the enclosing span's path with ``/``
+        (top-level spans may themselves be pre-pathed: "train/step").
+      registry: target registry (default: the process-local one).
+
+    The duration records even when the body raises — a failing step is
+    still a step that took time — and the annotation scope closes with
+    the region, so XPlane nesting matches the histogram paths.
+    """
+    reg = registry if registry is not None else default_registry()
+    stack = getattr(_state, "stack", None)
+    if stack is None:
+        stack = _state.stack = []
+    path = f"{stack[-1]}/{name}" if stack else name
+    stack.append(path)
+    t0 = time.perf_counter()
+    try:
+        with annotation(path):
+            yield path
+    finally:
+        dt = time.perf_counter() - t0
+        stack.pop()
+        reg.histogram("span_seconds", span=path).record(dt)

@@ -124,7 +124,8 @@ class LookaheadEngine:
                  dense_optimizer=None, strategy: str = "auto",
                  lookahead: int = 1, stale_ok: bool = False,
                  patch_capacity: Optional[int] = None,
-                 donate: Optional[bool] = None, fold_sort: bool = True):
+                 donate: Optional[bool] = None, fold_sort: bool = True,
+                 registry=None):
         if lookahead not in (0, 1):
             raise ValueError(
                 f"lookahead={lookahead}: only depths 0 and 1 are "
@@ -138,6 +139,13 @@ class LookaheadEngine:
         self.stats = {"steps": 0, "cold_fills": 0, "patch_overflows": 0,
                       "patched_steps": 0, "patched_samples": 0,
                       "patched_samples_max": 0}
+        # registry mirror of self.stats (ISSUE 11): counters bumped from
+        # THIS host-side driver body only — never inside a traced fn —
+        # plus the per-stage compile-count gauges the "must stay 1" SLO
+        # rule reads (tools/slo_tier1.json)
+        from distributed_embeddings_tpu.obs.registry import MetricRegistry
+        self._metrics = (registry if registry is not None
+                         else MetricRegistry())
         emb = self.emb
         # ONE optimizer construction (training._sparse_optimizer_setup)
         # shared with the monolithic step — the bit-exactness contract
@@ -411,6 +419,7 @@ class LookaheadEngine:
             carry = self._prefetch(params["embedding"], cats)
             idx_np = np.zeros((0,), np.int64)
             self.stats[cold] += 1
+            self._metrics.counter(f"lookahead/{cold}").inc()
         else:
             carry = self._slots.take()
 
@@ -440,6 +449,19 @@ class LookaheadEngine:
             self.stats["patched_samples"] += n_patched
             self.stats["patched_samples_max"] = max(
                 self.stats["patched_samples_max"], n_patched)
+        m = self._metrics
+        m.counter("lookahead/steps").inc()
+        if n_patched:
+            m.counter("lookahead/patched_steps").inc()
+            m.counter("lookahead/patched_samples").inc(n_patched)
+            m.gauge("lookahead/patched_samples_max").set(
+                self.stats["patched_samples_max"])
+        # executable-cache sizes as gauges — the compile-count SLO
+        # ("must stay 1 per (plan, batch-shape)") reads these
+        m.gauge("lookahead/compiles", stage="prefetch").set(
+            self._prefetch._cache_size())
+        m.gauge("lookahead/compiles", stage="fused").set(
+            self._fused._cache_size())
         return params, opt_state, loss
 
     # ------------------------------------------------------- lowering

@@ -15,9 +15,11 @@ top, not the other way around.
 
 Observability (the serving metrics the ROADMAP's "heavy traffic" goal
 needs): per-request queueing+compute latency lands in a
-`utils.metrics.LatencyHistogram` (p50/p95/p99), and every flush records
-queue depth and batch occupancy (true rows / padded rows). `summary()` bundles
-those with the engine's cache hit rate.
+``serve/request_seconds`` registry histogram (p50/p95/p99), and every
+flush records queue depth, batch occupancy (true rows / padded rows)
+and the engine's cache hit rate as registry counters/gauges (ISSUE 11 —
+pass ``registry=`` to land them in a shared run registry). `summary()`
+bundles the same numbers as one dict.
 """
 
 import time
@@ -26,7 +28,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 import jax
 
-from distributed_embeddings_tpu.utils.metrics import LatencyHistogram
+from distributed_embeddings_tpu.obs.registry import MetricRegistry
 
 __all__ = ["MicroBatcher"]
 
@@ -41,17 +43,25 @@ class MicroBatcher:
       max_batch: cap on true rows per forward (default: the engine's
         largest warmed shape, else 1024).
       clock: injectable time source (seconds) for latency accounting.
+      registry: optional `obs.MetricRegistry` for the serving metrics
+        (``serve/request_seconds``, ``serve/requests``,
+        ``serve/batches``, ``serve/batch_occupancy``,
+        ``serve/cache_hit_rate``). Default: a private registry —
+        per-batcher accounting, the historical behavior.
     """
 
     def __init__(self, engine, max_batch: Optional[int] = None,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 registry: Optional[MetricRegistry] = None):
         self.engine = engine
         warmed = getattr(engine, "_warmed", [])
         self.max_batch = int(max_batch or (max(warmed) if warmed else 1024))
         self.clock = clock
         self._queue: List[Tuple[int, Any, List, int, float]] = []
         self._next_handle = 0
-        self.latency = LatencyHistogram()
+        self._metrics = registry if registry is not None \
+            else MetricRegistry()
+        self.latency = self._metrics.histogram("serve/request_seconds")
         self.requests = 0
         self.batches = 0
         self.queue_depth_max = 0
@@ -76,6 +86,7 @@ class MicroBatcher:
         self._next_handle += 1
         self._queue.append((handle, numerical, cats, rows, self.clock()))
         self.requests += 1
+        self._metrics.counter("serve/requests").inc()
         self.queue_depth_max = max(self.queue_depth_max, len(self._queue))
         return handle
 
@@ -115,6 +126,7 @@ class MicroBatcher:
             done = self.clock()
             padded = self.engine._target_batch(rows)
             self.batches += 1
+            self._metrics.counter("serve/batches").inc()
             self._occupancy_rows += rows
             self._padded_rows += padded
             start = 0
@@ -123,6 +135,17 @@ class MicroBatcher:
                 results[handle] = jax.tree.map(lambda a, s=sl: a[s], out)
                 start += n
                 self.latency.record(done - t_in)
+        m = self._metrics
+        m.gauge("serve/batch_occupancy").set(
+            self._occupancy_rows / self._padded_rows
+            if self._padded_rows else 0.0)
+        # cheap attribute sums, not cache_stats() (which builds
+        # per-bucket dicts) — this runs per flush
+        caches = getattr(self.engine, "caches", {}) or {}
+        hits = sum(c.hits for c in caches.values())
+        misses = sum(c.misses for c in caches.values())
+        m.gauge("serve/cache_hit_rate").set(
+            hits / (hits + misses) if hits + misses else 0.0)
         return results
 
     def summary(self) -> dict:

@@ -89,11 +89,18 @@ class InferenceEngine:
         publisher's ``vocab_v{version}.npz`` sidecars alongside the row
         deltas, so rebinds arrive through the same publication path as
         the row payloads they describe.
+      registry: optional `obs.MetricRegistry` (ISSUE 11) the engine's
+        serving counters (``serve/predicts``, ``serve/rows_served``,
+        ``serve/rows_padded``) land in — and which the owned
+        `TableStore` (and its `DeltaConsumer`s) report through
+        (``store/applies``, ``store/version_lag``,
+        ``store/publish_to_apply_seconds``...). Default: a private
+        registry per engine.
     """
 
     def __init__(self, model, params, *, cache_capacity=0,
                  promote_threshold: int = 2, donate_batch: bool = False,
-                 vocab_manager=None):
+                 vocab_manager=None, registry=None):
         if isinstance(model, DistributedEmbedding):
             self._model = None
             self.embedding = model
@@ -108,11 +115,15 @@ class InferenceEngine:
                 and "opt_state" in params:
             params = params["params"]      # checkpoint dict: strip opt state
         self.params = params
+        from distributed_embeddings_tpu.obs.registry import MetricRegistry
+        self._metrics = registry if registry is not None \
+            else MetricRegistry()
         # versioned ownership (ISSUE 6): the embedding tables live behind
         # a TableStore — `refresh()` and delta consumption read/write
         # through it, so serving can never hold a second derivation of
         # the row state
-        self.store = TableStore(self.embedding, self._emb_params(params))
+        self.store = TableStore(self.embedding, self._emb_params(params),
+                                registry=self._metrics)
         self._consumers: Dict[str, DeltaConsumer] = {}
         if vocab_manager is not None and vocab_manager.emb is not \
                 self.embedding:
@@ -296,6 +307,9 @@ class InferenceEngine:
         self.n_predicts += 1
         self.rows_served += b
         self.rows_padded += target - b
+        self._metrics.counter("serve/predicts").inc()
+        self._metrics.counter("serve/rows_served").inc(b)
+        self._metrics.counter("serve/rows_padded").inc(target - b)
         return jax.tree.map(lambda a: a[:b], out)
 
     def warmup(self, batch_sizes: Sequence[int], example=None) -> List[int]:

@@ -208,10 +208,24 @@ class TableStore:
       snapshot_every: every N-th publish is a full-snapshot compaction
         (0/None = only the mandatory first publish; env default
         `DET_STORE_SNAPSHOT_EVERY`).
+      registry: optional `obs.MetricRegistry` (ISSUE 11) the store's
+        streaming metrics land in — producer counters
+        (``store/publishes``, ``store/publish_bytes``,
+        ``store/publish_rows``), consumer counters (``store/applies``,
+        ``store/apply_bytes``, ``store/apply_rows``) and the
+        ``store/version{role=publisher|consumer}`` gauges;
+        `DeltaConsumer` adds the staleness
+        family (``store/version_lag``,
+        ``store/publish_to_apply_seconds``). Default: a private
+        registry; `training.fit` rebinds its publisher store onto the
+        run registry via `use_registry`.
     """
 
     def __init__(self, emb, params: dict, opt_states: Optional[dict] = None,
-                 snapshot_every: Optional[int] = None):
+                 snapshot_every: Optional[int] = None, registry=None):
+        from distributed_embeddings_tpu.obs.registry import MetricRegistry
+        self._metrics = registry if registry is not None \
+            else MetricRegistry()
         self.emb = emb
         self._params = params
         self._opt = opt_states
@@ -255,6 +269,13 @@ class TableStore:
         self._chain_broken = False
 
     # ------------------------------------------------------------- state
+    def use_registry(self, registry) -> None:
+        """Rebind the store's metrics onto `registry` (ISSUE 11) —
+        `training.fit` calls this so a run's publisher reports into the
+        ONE run registry. Counts accumulated in the previous registry
+        stay there (instruments are resolved per event, not cached)."""
+        self._metrics = registry
+
     @property
     def params(self) -> dict:
         return self._params
@@ -475,9 +496,18 @@ class TableStore:
         os.replace(tmp, path)
         self._published_version = self.version
         self._pending = {}
-        return {"kind": meta["kind"], "version": self.version,
+        info = {"kind": meta["kind"], "version": self.version,
                 "base_version": meta["base_version"], "path": path,
                 "bytes": os.path.getsize(path), "rows": n_rows}
+        m = self._metrics
+        m.counter("store/publishes").inc()
+        m.counter("store/publish_bytes").inc(info["bytes"])
+        m.counter("store/publish_rows").inc(n_rows)
+        # role-labeled: a publisher and a consumer store on ONE shared
+        # run registry (the bench serve mode shape) must not flap a
+        # single version gauge between the two meanings
+        m.gauge("store/version", role="publisher").set(self.version)
+        return info
 
     # --------------------------------------------------------- consuming
     def _check_sig(self, meta: dict, path: str) -> None:
@@ -578,10 +608,16 @@ class TableStore:
             self._params = new_params
         self.version = int(meta["version"])
         self._published_version = None     # consumers never publish onward
-        return {"kind": meta["kind"], "version": self.version,
+        info = {"kind": meta["kind"], "version": self.version,
                 "rows": n_rows, "bytes": os.path.getsize(path),
                 "published_at": meta.get("published_at"),
                 "payload": payload}
+        m = self._metrics
+        m.counter("store/applies").inc()
+        m.counter("store/apply_bytes").inc(info["bytes"])
+        m.counter("store/apply_rows").inc(n_rows)
+        m.gauge("store/version", role="consumer").set(self.version)
+        return info
 
 
 class DeltaConsumer:
@@ -620,9 +656,14 @@ class DeltaConsumer:
             # staleness just before this poll: how many published
             # versions serving had not yet consumed
             self._lag_versions.append(newer[-1][0] - self.store.version)
+            self.store._metrics.gauge("store/version_lag").set(
+                self._lag_versions[-1])
         out = []
+        latest_seen = self.store.version
         while True:
             files = scan_published(self.directory)
+            if files:
+                latest_seen = max(latest_seen, files[-1][0])
             if self.store._chain_broken:
                 # out-of-band replace: the local version bump is
                 # meaningless against the publisher's namespace, so no
@@ -660,8 +701,18 @@ class DeltaConsumer:
             if info.get("published_at"):
                 self._lag_seconds.append(
                     max(time.time() - info["published_at"], 0.0))
+                self.store._metrics.histogram(
+                    "store/publish_to_apply_seconds").record(
+                        self._lag_seconds[-1])
             self.applied.append(info)
             out.append(info)
+        if out:
+            # post-poll residual lag (0 when fully caught up; >0 when the
+            # chain still waits on the publisher's next compaction) —
+            # from the apply loop's own final scan, no extra directory
+            # walk on the serving hot path
+            self.store._metrics.gauge("store/version_lag").set(
+                max(0, latest_seen - self.store.version))
         return out
 
     def stats(self) -> dict:

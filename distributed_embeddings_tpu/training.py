@@ -364,7 +364,7 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         pipeline_depth: int = 2, hot_sync_every: int = 0,
         store=None, publish_every: int = 0, publish_dir=None,
         vocab=None, vocab_every: int = 16,
-        lookahead=None, stale_ok: bool = False):
+        lookahead=None, stale_ok: bool = False, registry=None):
     """Minimal training-loop driver — the role the reference fills with
     Keras `model.fit` + `DistributedOptimizer` + callbacks
     (reference dist_model_parallel.py:1270-1326, synthetic main.py:104-114).
@@ -455,6 +455,23 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         invalidate already-prefetched physical rows. Translate-only
         vocab use (``vocab_every=0``) composes: batches are translated
         when PULLED, before the engine prefetches them.
+      registry: optional `obs.MetricRegistry` — the run's ONE metric
+        namespace (ISSUE 11). fit threads it through everything it
+        drives: the ingest pipeline (``ingest/stage_seconds{stage=}``),
+        the lookahead engine (patch counters + the compile-count
+        gauges), and — via their ``use_registry`` rebind, only when an
+        explicit registry is passed here — the publisher `store` and
+        the `vocab` manager (a caller-attached registry on those
+        components is respected otherwise); fit's own loop adds
+        ``span_seconds{span=train/step}`` wall-time spans,
+        ``train/steps`` / ``train/examples`` counters, the
+        ``train/examples_per_sec`` / ``train/publish_cadence_steps``
+        gauges, and the static ``exchange/*`` gauges from
+        `exchange_padding_report` (exported at run end, so they reflect
+        the final vocab occupancy). ``None`` creates a private per-run
+        registry — either way the final snapshot lands in
+        ``history["metrics_snapshot"]``, and ``DET_OBS_EXPORT=<path>``
+        appends it as one JSONL line there (the soak-run export).
       hot_sync_every: hot-row replication cadence (layers built with
         `hot_rows=`, sparse path only): every N steps the loop runs
         `sync_hot_rows(admit=True)` — write hot rows back to the
@@ -472,6 +489,9 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
     ('loss' as floats, drained from device at sync/log boundaries;
     optionally 'eval_auc').
     """
+    from distributed_embeddings_tpu.obs.registry import MetricRegistry
+    from distributed_embeddings_tpu.obs.spans import span
+    reg = registry if registry is not None else MetricRegistry()
     if lookahead is None:
         from distributed_embeddings_tpu.schedule import default_lookahead
         lookahead = default_lookahead()
@@ -499,7 +519,7 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         from distributed_embeddings_tpu.schedule import LookaheadEngine
         la_engine = LookaheadEngine(
             model, optimizer, lr=lr, dense_optimizer=dense_optimizer,
-            lookahead=lookahead, stale_ok=stale_ok)
+            lookahead=lookahead, stale_ok=stale_ok, registry=reg)
         step_fn = None
         if opt_state is None:
             opt_state = la_engine.init(params)
@@ -550,7 +570,8 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         import itertools
         pipeline = staged_batches(itertools.islice(iter(data), steps),
                                   stage=stage, preprocess=preprocess,
-                                  depth=pipeline_depth, pipelined=pipelined)
+                                  depth=pipeline_depth, pipelined=pipelined,
+                                  registry=reg)
         it = iter(pipeline)
     else:
         it = None
@@ -570,6 +591,19 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
     publishing = bool(sparse and store is not None and publish_every)
     if publishing and publish_dir is None:
         raise ValueError("publish_every requires publish_dir")
+    # one metric namespace per run (ISSUE 11): with an EXPLICIT run
+    # registry, caller-built components rebind onto it so their
+    # counters land in the same snapshot as fit's own. Without one,
+    # they keep whatever registry they were built with — silently
+    # stealing a store/vocab off a registry the caller attached for
+    # their own export would freeze that registry mid-run.
+    if registry is not None:
+        if store is not None:
+            store.use_registry(reg)
+        if vocab is not None:
+            vocab.use_registry(reg)
+    if publishing:
+        reg.gauge("train/publish_cadence_steps").set(publish_every)
     if vocab is not None and not sparse:
         raise ValueError("vocab management requires the sparse path "
                          "(sparse=True)")
@@ -619,6 +653,9 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         return b
 
     next_batch = None
+    examples_total = 0
+    import time as _time
+    t_run0 = _time.perf_counter()
     try:
         for step in range(steps):
             if la_engine is not None:
@@ -659,14 +696,25 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
                         params["embedding"], opt_state["emb"], admit=True)
                     params = {**params, "embedding": p_emb}
                     opt_state = {**opt_state, "emb": s_emb}
-            if la_engine is not None:
-                params, opt_state, loss = la_engine.step(
-                    params, opt_state, batch, next_batch)
-            else:
-                params, opt_state, loss = step_fn(
-                    params, opt_state, jnp.asarray(numerical),
-                    [jnp.asarray(c) for c in cats], jnp.asarray(labels))
+            # span = host wall time of the step DISPATCH (plus any host
+            # work the engine does); device time hides behind async
+            # dispatch except at sync boundaries — the honest host-side
+            # reading, same clock the reference's fit loop shows
+            with span("train/step", reg):
+                if la_engine is not None:
+                    params, opt_state, loss = la_engine.step(
+                        params, opt_state, batch, next_batch)
+                else:
+                    params, opt_state, loss = step_fn(
+                        params, opt_state, jnp.asarray(numerical),
+                        [jnp.asarray(c) for c in cats],
+                        jnp.asarray(labels))
             pending.append(loss)
+            shp = getattr(labels, "shape", None)
+            n_ex = int(shp[0]) if shp else len(labels)
+            examples_total += n_ex
+            reg.counter("train/steps").inc()
+            reg.counter("train/examples").inc(n_ex)
             if publishing:
                 steps_since_publish += 1
                 if steps_since_publish >= publish_every:
@@ -721,6 +769,25 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         # leftover tail steps — and any rows the tail vocab cycle just
         # rebound — reach replicas too
         publish_now()
+    # ---- run-end telemetry (ISSUE 11): throughput gauge, the static
+    # exchange/* gauges (exported LAST so occupancy reflects the tail
+    # vocab cycle), the embedded snapshot, and the JSONL export hook
+    elapsed = max(_time.perf_counter() - t_run0, 1e-9)
+    reg.gauge("train/examples_per_sec").set(examples_total / elapsed)
+    emb = getattr(model, "embedding", None)
+    if emb is not None and hasattr(emb, "exchange_padding_report"):
+        try:
+            from distributed_embeddings_tpu.obs.instrument import (
+                export_exchange_gauges)
+            export_exchange_gauges(
+                reg, emb, batch=max(examples_total // max(steps, 1), 1),
+                vocab=vocab, lookahead=int(lookahead or 0))
+        except Exception as e:  # noqa: BLE001 - accounting never kills a run
+            history["metrics_error"] = str(e)[:200]
+    history["metrics_snapshot"] = reg.snapshot()
+    export_path = os.environ.get("DET_OBS_EXPORT")
+    if export_path:
+        reg.export_jsonl(export_path, extra={"source": "fit"})
     return params, opt_state, history
 
 

@@ -9,7 +9,11 @@ the final trapezoidal integration on host at epoch end.
 `LatencyHistogram` is the serving-side counterpart: a host-side,
 geometrically-bucketed latency histogram the micro-batcher uses for
 p50/p95/p99 request latency (serving/batcher.py) — O(1) per record, fixed
-memory, no per-request list growth on long-lived servers.
+memory, no per-request list growth on long-lived servers. Since ISSUE 11
+it LIVES in `obs.registry` (it is the metric registry's histogram type);
+this re-export keeps serving/pipeline/bench imports unchanged. New code
+should obtain histograms through a `MetricRegistry` — direct construction
+outside ``obs/`` is lint-banned (``shadow-metric``).
 """
 
 from typing import NamedTuple, Tuple
@@ -17,6 +21,8 @@ from typing import NamedTuple, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from distributed_embeddings_tpu.obs.registry import LatencyHistogram
 
 __all__ = ["StreamingAUC", "auc_exact", "LatencyHistogram"]
 
@@ -71,97 +77,6 @@ class StreamingAUC:
         fpr = np.concatenate([[0.0], fpr])
         trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2
         return float(trapezoid(tpr, fpr))
-
-
-class LatencyHistogram:
-    """Geometric-bucket latency histogram with percentile estimates.
-
-    O(1) `record`, fixed memory (`~bins_per_decade * decades` int64 slots),
-    so a long-lived server can keep one per metric without unbounded
-    per-request lists. Percentiles interpolate within the winning bucket —
-    with the default 32 buckets/decade the edge-quantization error is
-    < 7.5%, far below the run-to-run variance of real serving latencies.
-
-    Usage:
-      h = LatencyHistogram()
-      h.record(0.0123)                  # seconds
-      h.percentile(99)                  # seconds
-      h.summary()                       # {"count", "p50_ms", ...}
-    """
-
-    def __init__(self, lo: float = 1e-6, hi: float = 120.0,
-                 bins_per_decade: int = 32):
-        if not (0 < lo < hi):
-            raise ValueError(f"need 0 < lo < hi, got {lo}, {hi}")
-        self.lo = float(lo)
-        decades = np.log10(hi / lo)
-        self.bins = int(np.ceil(decades * bins_per_decade)) + 1
-        self._ratio = 10.0 ** (1.0 / bins_per_decade)
-        # edges[i] = lo * ratio^i; bucket i holds (edges[i-1], edges[i]]
-        self._edges = lo * self._ratio ** np.arange(self.bins)
-        self._counts = np.zeros((self.bins + 1,), np.int64)  # +overflow
-        self._total = 0.0
-        self._max = 0.0
-
-    def record(self, seconds: float) -> None:
-        s = max(float(seconds), 0.0)
-        idx = int(np.searchsorted(self._edges, s, side="left"))
-        self._counts[min(idx, self.bins)] += 1
-        self._total += s
-        self._max = max(self._max, s)
-
-    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
-        """Fold another histogram's counts into this one (in place;
-        returns self for chaining). Lets per-rep/per-stage histograms
-        aggregate into one distribution — e.g. the ingest bench's
-        per-stage timings across interleaved repetitions — instead of
-        only the last rep surviving. Bucket layouts must match exactly
-        (same lo/hi/bins_per_decade): merging differently-edged
-        histograms would silently misfile counts."""
-        if (self.lo, self.bins, self._ratio) != (other.lo, other.bins,
-                                                 other._ratio):
-            raise ValueError(
-                "cannot merge LatencyHistograms with different bucket "
-                f"layouts: (lo={self.lo}, bins={self.bins}, "
-                f"ratio={self._ratio}) vs (lo={other.lo}, "
-                f"bins={other.bins}, ratio={other._ratio})")
-        self._counts += other._counts
-        self._total += other._total
-        self._max = max(self._max, other._max)
-        return self
-
-    @property
-    def count(self) -> int:
-        return int(self._counts.sum())
-
-    def percentile(self, p: float) -> float:
-        """The p-th percentile (0..100) in seconds; 0.0 when empty."""
-        n = self.count
-        if n == 0:
-            return 0.0
-        rank = np.ceil(n * min(max(p, 0.0), 100.0) / 100.0)
-        cum = np.cumsum(self._counts)
-        idx = int(np.searchsorted(cum, max(rank, 1)))
-        if idx >= self.bins:
-            return self._max
-        hi = self._edges[idx]
-        lo = self._edges[idx - 1] if idx else 0.0
-        # linear interpolation inside the bucket by rank position, capped
-        # by the true max so a wide top bucket cannot report p99 > max
-        prev = cum[idx - 1] if idx else 0
-        frac = (rank - prev) / max(self._counts[idx], 1)
-        return float(min(lo + (hi - lo) * frac, self._max))
-
-    def summary(self) -> dict:
-        n = self.count
-        return {
-            "count": n,
-            "mean_ms": round(self._total / n * 1e3, 3) if n else 0.0,
-            "p50_ms": round(self.percentile(50) * 1e3, 3),
-            "p95_ms": round(self.percentile(95) * 1e3, 3),
-            "p99_ms": round(self.percentile(99) * 1e3, 3),
-            "max_ms": round(self._max * 1e3, 3),
-        }
 
 
 def auc_exact(labels: np.ndarray, scores: np.ndarray) -> float:

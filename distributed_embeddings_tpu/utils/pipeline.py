@@ -20,8 +20,10 @@ Contract highlights:
     exception re-raises at the call site (no hang, no silent drop).
   * Clean shutdown: `close()` (or exhaustion, or the context manager) stops
     and joins every worker; no threads leak across pipeline lifetimes.
-  * Accounting: per-stage wall time lands in a
-    `utils.metrics.LatencyHistogram` per stage (`stage_summaries()`), and
+  * Accounting: per-stage wall time lands in an
+    ``ingest/stage_seconds{stage=...}`` histogram family of an
+    `obs.MetricRegistry` (`stage_summaries()` reads them; pass
+    ``registry=`` to land them in a shared run registry — ISSUE 11), and
     each stage body runs under a `utils.profiling.annotate` region so
     profiler traces show where ingestion time goes.
 """
@@ -31,7 +33,7 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence, Tuple
 
-from distributed_embeddings_tpu.utils.metrics import LatencyHistogram
+from distributed_embeddings_tpu.obs.registry import MetricRegistry
 
 __all__ = ["IngestPipeline", "SerialPipeline", "READ_STAGE"]
 
@@ -54,13 +56,14 @@ class _Failure:
 
 
 def _annotate(name: str):
-    """profiling.annotate, tolerating backends with no profiler configured."""
-    from distributed_embeddings_tpu.utils import profiling
-    try:
-        return profiling.annotate(f"ingest/{name}")
-    except Exception:  # noqa: BLE001 - accounting must never break ingestion
-        import contextlib
-        return contextlib.nullcontext()
+    """profiling.annotate, tolerating backends with no profiler configured.
+
+    Delegates to `obs.spans.annotation`, whose works/doesn't-work probe
+    is cached process-wide — a profiler-less backend pays ONE failed
+    construction total, not one raised-and-swallowed exception per stage
+    invocation on every batch (measurable overhead at ingest rates)."""
+    from distributed_embeddings_tpu.obs.spans import annotation
+    return annotation(f"ingest/{name}")
 
 
 class IngestPipeline:
@@ -79,6 +82,12 @@ class IngestPipeline:
         Total in-flight batches are capped at
         ``(len(stages) + 1) * depth + len(stages)``.
       name: thread-name prefix (useful in py-spy / faulthandler dumps).
+      registry: optional `obs.MetricRegistry` the per-stage histograms
+        are created in, as ``ingest/stage_seconds{stage=...}`` families
+        (ISSUE 11 — `training.fit` passes its run registry so ingest
+        timing lands in the unified snapshot). Default: a private
+        registry, preserving per-instance accounting; each stage's
+        histogram has exactly one writer thread either way.
 
     Iterate it like any iterator; `close()` is called automatically on
     exhaustion and on `with` exit, and is idempotent. A worker exception
@@ -87,7 +96,8 @@ class IngestPipeline:
     """
 
     def __init__(self, source: Iterable, stages: Sequence[Tuple[str, Callable]],
-                 depth: int = 2, name: str = "ingest"):
+                 depth: int = 2, name: str = "ingest",
+                 registry: Optional[MetricRegistry] = None):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self._stages = [(str(n), fn) for n, fn in stages]
@@ -99,7 +109,9 @@ class IngestPipeline:
         self._depth = int(depth)
         self._stop = threading.Event()
         self._closed = False
-        self._hists = {n: LatencyHistogram() for n in names}
+        reg = registry if registry is not None else MetricRegistry()
+        self._hists = {n: reg.histogram("ingest/stage_seconds", stage=n)
+                       for n in names}
         # queues[0] feeds stage 0; queues[-1] feeds the consumer
         self._queues = [queue_lib.Queue(maxsize=self._depth)
                         for _ in range(len(self._stages) + 1)]
@@ -273,12 +285,13 @@ class SerialPipeline:
     and the parity reference for tests (pipelined output must be
     bit-identical to this iteration order)."""
 
-    def __init__(self, source: Iterable, stages: Sequence[Tuple[str, Callable]]):
+    def __init__(self, source: Iterable, stages: Sequence[Tuple[str, Callable]],
+                 registry: Optional[MetricRegistry] = None):
         self._source = iter(source)
         self._stages = [(str(n), fn) for n, fn in stages]
-        self._hists = {READ_STAGE: LatencyHistogram()}
-        for n, _ in self._stages:
-            self._hists[n] = LatencyHistogram()
+        reg = registry if registry is not None else MetricRegistry()
+        self._hists = {n: reg.histogram("ingest/stage_seconds", stage=n)
+                       for n in [READ_STAGE] + [n for n, _ in self._stages]}
 
     def __iter__(self):
         return self
@@ -305,7 +318,8 @@ class SerialPipeline:
 
 def staged_batches(data: Iterable, stage: Optional[Callable] = None,
                    preprocess: Optional[Callable] = None, depth: int = 2,
-                   pipelined: bool = True) -> Any:
+                   pipelined: bool = True,
+                   registry: Optional[MetricRegistry] = None) -> Any:
     """Convenience constructor for the common train-loop shape.
 
     Args:
@@ -318,6 +332,8 @@ def staged_batches(data: Iterable, stage: Optional[Callable] = None,
       depth: per-queue bound.
       pipelined: False returns the serial (inline) form with identical
         output — the A/B switch `training.fit(pipelined=...)` exposes.
+      registry: optional `obs.MetricRegistry` for the per-stage
+        histograms (see `IngestPipeline`).
     """
     import jax
     stages = []
@@ -325,5 +341,5 @@ def staged_batches(data: Iterable, stage: Optional[Callable] = None,
         stages.append(("preprocess", preprocess))
     stages.append(("stage", stage or jax.device_put))
     if pipelined:
-        return IngestPipeline(data, stages, depth=depth)
-    return SerialPipeline(data, stages)
+        return IngestPipeline(data, stages, depth=depth, registry=registry)
+    return SerialPipeline(data, stages, registry=registry)

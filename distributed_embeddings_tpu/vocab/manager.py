@@ -383,6 +383,14 @@ class VocabManager:
       stash_max: per-table bound on the host-side demotion stash
         (None = one table's worth of rows); the oldest stashed demotion
         drops first, and a dropped key re-admits from zeros.
+      registry: optional `obs.MetricRegistry` (ISSUE 11) the manager's
+        vocabulary metrics land in — ``vocab/admissions`` /
+        ``vocab/evictions`` counters and the ``vocab/occupancy`` /
+        ``vocab/high_watermark`` / ``vocab/low_watermark`` /
+        ``vocab/fallback_hit_rate`` / ``vocab/bound_rows`` gauges
+        (updated after every observing translate and every maintain
+        cycle). Default: a private registry; `training.fit` rebinds via
+        `use_registry`.
 
     Workflow::
 
@@ -399,7 +407,8 @@ class VocabManager:
                  replan_watermark: float = 0.98, on_miss: str = "fallback",
                  max_admit_per_cycle: Optional[int] = None,
                  use_native: Optional[bool] = None,
-                 stash_max: Optional[int] = None, log_fn=None):
+                 stash_max: Optional[int] = None, log_fn=None,
+                 registry=None):
         if not emb.dp_input:
             raise ValueError(
                 "VocabManager translates data-parallel input batches; this "
@@ -482,6 +491,44 @@ class VocabManager:
         # wiring, the honest "per step" denominator for eviction rates
         self.observe_steps = 0
         self._replan_warned: set = set()
+        from distributed_embeddings_tpu.obs.registry import MetricRegistry
+        self._metrics = registry if registry is not None \
+            else MetricRegistry()
+        # last cumulative totals already exported as counter increments
+        self._exported = {"admissions": 0, "evictions": 0}
+
+    def use_registry(self, registry) -> None:
+        """Rebind metrics onto `registry` (ISSUE 11; the
+        `TableStore.use_registry` idiom — `training.fit` unifies the
+        run's namespace through this). Counter baselines carry over, so
+        only admissions/evictions that happen AFTER the rebind land in
+        the new registry."""
+        self._metrics = registry
+
+    def _export_metrics(self) -> None:
+        """Refresh the registry view of the manager (cheap: O(tables)
+        attribute sums — called per observing translate and per
+        maintain cycle). Admissions/evictions export as counter DELTAS
+        against the cumulative per-table totals; occupancy/fallback
+        rate as gauges."""
+        adm = sum(mv.admissions for mv in self.vocabs.values())
+        ev = sum(mv.evictions for mv in self.vocabs.values())
+        m = self._metrics
+        m.counter("vocab/admissions").inc(adm - self._exported["admissions"])
+        m.counter("vocab/evictions").inc(ev - self._exported["evictions"])
+        self._exported = {"admissions": adm, "evictions": ev}
+        cap = sum(mv.capacity - 1 for mv in self.vocabs.values())
+        bound = sum(mv.bound for mv in self.vocabs.values())
+        tr = sum(mv.translated for mv in self.vocabs.values())
+        fb = sum(mv.fallback_hits for mv in self.vocabs.values())
+        m.gauge("vocab/occupancy").set(bound / cap if cap else 0.0)
+        m.gauge("vocab/bound_rows").set(bound)
+        m.gauge("vocab/high_watermark").set(self.high_watermark)
+        m.gauge("vocab/low_watermark").set(self.low_watermark)
+        m.gauge("vocab/fallback_hit_rate").set(fb / tr if tr else 0.0)
+        m.gauge("vocab/maintain_cycles").set(self.maintain_cycles)
+        for gtid, mv in self.vocabs.items():
+            m.gauge("vocab/occupancy", table=gtid).set(mv.occupancy)
 
     # ---------------------------------------------------------- geometry
     def _eligible_tables(self) -> List[int]:
@@ -591,6 +638,10 @@ class VocabManager:
             out.append(self._translate_one(mv, x, raws_out=raws))
         for gtid, chunks in per_table_raws.items():
             self.vocabs[gtid].observe(np.concatenate(chunks))
+        if observe:
+            # training-side translate = one step: refresh the registry
+            # view (fallback-hit rate moves per batch, not per cycle)
+            self._export_metrics()
         return out
 
     # ---------------------------------------------------------- maintain
@@ -724,6 +775,7 @@ class VocabManager:
             if ok.any():
                 params, opt_states = self._write_admitted(
                     params, opt_states, gtid, keys[ok], rows[ok])
+        self._export_metrics()
         return params, opt_states
 
     @property

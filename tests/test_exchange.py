@@ -469,3 +469,71 @@ def test_ragged_exchange_native_lowering(monkeypatch):
     assert "ragged_all_to_all" in txt, txt[:2000]
 
 
+
+
+def test_exchange_report_matches_registry_gauges_after_driven_run():
+    """ISSUE 11 consistency seam: the `exchange/*` gauges a driven
+    `training.fit` exports must EQUAL a fresh
+    `exchange_padding_report` over the same (batch, vocab, lookahead)
+    arguments — touched_rows_per_step, occupancy and
+    prefetch_patch_rows_per_step at both the top level and per group.
+    The model is static accounting either way; what this pins is the
+    WIRING (fit exporting the report's numbers, with the live manager,
+    at the run's true batch size, after the tail vocab cycle)."""
+    from distributed_embeddings_tpu import obs, training
+    from distributed_embeddings_tpu.vocab import VocabManager
+    from distributed_embeddings_tpu.obs.instrument import (
+        EXCHANGE_GAUGE_FIELDS, EXCHANGE_GROUP_GAUGE_FIELDS)
+
+    sizes = [(48, 8), (32, 8), (100, 8), (64, 8)]
+    dist = DistributedEmbedding(
+        [Embedding(v, w, combiner="sum") for v, w in sizes],
+        mesh=create_mesh(jax.devices()[:8]),
+        strategy="memory_balanced", vocab_slack=16)
+
+    class _M:
+        def __init__(self, emb):
+            self.embedding = emb
+
+        def loss_fn(self, params, numerical, cats, labels, taps=None,
+                    return_residuals=False):
+            outs, res = self.embedding.apply(
+                params["embedding"], cats, taps=taps,
+                return_residuals=True)
+            x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs],
+                                axis=1)
+            loss = jnp.mean((jnp.sum(x, axis=1) - labels.reshape(-1)) ** 2)
+            return (loss, res) if return_residuals else loss
+
+    model = _M(dist)
+    mgr = VocabManager(dist, admit_threshold=1, decay=0.99,
+                       use_native=False)
+    rng = np.random.RandomState(3)
+
+    def data(step):
+        cats = [rng.randint(10**8, 10**8 + 40,
+                            size=(16, 2)).astype(np.int64) for _ in sizes]
+        return (np.zeros((16, 1), np.float32), cats,
+                rng.randn(16).astype(np.float32))
+
+    reg = obs.MetricRegistry()
+    params = {"embedding": dist.init(jax.random.PRNGKey(0))}
+    params, _, hist = training.fit(
+        model, params, data, steps=6, optimizer="adagrad", lr=0.05,
+        vocab=mgr, vocab_every=3, registry=reg, log_every=0)
+    assert "metrics_error" not in hist, hist.get("metrics_error")
+
+    gauges = reg.snapshot()["gauges"]
+    rep = dist.exchange_padding_report(batch=16, vocab=mgr, lookahead=0)
+    for field in EXCHANGE_GAUGE_FIELDS:
+        assert gauges[f"exchange/{field}"] == pytest.approx(rep[field]), \
+            field
+    for gi, entry in enumerate(rep["groups"]):
+        for field in EXCHANGE_GROUP_GAUGE_FIELDS:
+            key = (f"exchange/{field}"
+                   f"{{bucket={entry['bucket']},group={gi}}}")
+            assert gauges[key] == pytest.approx(entry[field]), key
+    # the manager actually moved the needle: a live binding, not the
+    # static 1.0 occupancy
+    assert 0.0 < gauges["exchange/occupancy"] < 1.0
+    assert gauges["exchange/touched_rows_per_step"] > 0

@@ -181,6 +181,65 @@ def test_wallclock_outside_jit_modules_ok(tmp_path):
 
 
 # ------------------------------------------------------------- plumbing
+# ---------------------------------------------------- shadow-metric
+def test_shadow_metric_flagged_outside_obs(tmp_path):
+    src = (
+        "from distributed_embeddings_tpu.utils.metrics import "
+        "LatencyHistogram\n"
+        "from distributed_embeddings_tpu.obs import registry as r\n"
+        "from collections import Counter\n"
+        "h = LatencyHistogram()\n"
+        "c = r.Counter('x', {})\n"
+        "g = r.Gauge('y', {})\n"
+        "ok = Counter([1, 2])\n"          # collections.Counter untouched
+    )
+    fs = _lint_src(tmp_path, src,
+                   rel=os.path.join(PKG, "serving", "other.py"))
+    assert [f.rule for f in fs] == ["shadow-metric"] * 3
+    assert [f.line for f in fs] == [4, 5, 6]
+
+
+def test_shadow_metric_alias_and_deep_import_forms(tmp_path):
+    src = (
+        "from distributed_embeddings_tpu.obs.registry import "
+        "LatencyHistogram as LH\n"
+        "import distributed_embeddings_tpu.obs.registry as reg\n"
+        "a = LH()\n"
+        "b = reg.Gauge('g', {})\n"
+    )
+    fs = _lint_src(tmp_path, src,
+                   rel=os.path.join(PKG, "store", "other.py"))
+    assert [f.rule for f in fs] == ["shadow-metric"] * 2
+
+
+def test_shadow_metric_allowed_in_obs_and_by_escape(tmp_path):
+    src = (
+        "from distributed_embeddings_tpu.utils.metrics import "
+        "LatencyHistogram\n"
+        "h = LatencyHistogram()\n"
+    )
+    # anywhere under obs/ is the sanctioned construction home
+    assert _lint_src(tmp_path, src,
+                     rel=os.path.join(PKG, "obs", "registry.py")) == []
+    assert _lint_src(tmp_path, src,
+                     rel=os.path.join(PKG, "obs", "spans.py")) == []
+    escaped = (
+        "from distributed_embeddings_tpu.utils.metrics import "
+        "LatencyHistogram\n"
+        "h = LatencyHistogram()  # lint: allow(shadow-metric)\n"
+    )
+    assert _lint_src(tmp_path, escaped,
+                     rel=os.path.join(PKG, "serving", "other.py")) == []
+    # registry USE is exactly what the rule steers toward: never flagged
+    use = (
+        "def f(reg):\n"
+        "    reg.histogram('serve/request_seconds').record(0.01)\n"
+        "    reg.counter('n').inc()\n"
+    )
+    assert _lint_src(tmp_path, use,
+                     rel=os.path.join(PKG, "serving", "other.py")) == []
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     fs = _lint_src(tmp_path, "def broken(:\n",
                    rel=os.path.join(PKG, "ops", "x.py"))

@@ -20,8 +20,19 @@ tests/test_lint_invariants.py):
                      jitted-code modules (ops/, layers/, parallel/,
                      schedule/) — a wall clock read inside a traced
                      function freezes ONE timestamp into the compiled
-                     program; host-side timing belongs in utils/ or the
-                     drivers.
+                     program; host-side timing belongs in utils/, obs/
+                     or the drivers.
+  shadow-metric      no direct ``LatencyHistogram`` / ``Counter`` /
+                     ``Gauge`` construction (the obs.registry metric
+                     classes) outside ``obs/`` — ONE metric namespace
+                     (ISSUE 11): components obtain instruments through
+                     a `MetricRegistry` (``registry.histogram(...)``),
+                     never by hand-rolling a private histogram the
+                     snapshot/SLO layer cannot see. Import-tracked, so
+                     ``from ...utils.metrics import LatencyHistogram``
+                     aliases and module-attribute forms cannot evade —
+                     and ``collections.Counter`` stays untouched (only
+                     names imported from the metric modules count).
 
 Escapes: append ``# lint: allow(<rule>)`` to the offending line (or the
 line directly above). Escapes are themselves greppable, which is the
@@ -53,6 +64,17 @@ HOT_ALLOWED = (os.path.join("layers", "dist_model_parallel.py"),
 # modules whose code runs under jit traces: a wall-clock call here is
 # either traced (frozen constant) or a host sync hazard
 JIT_MODULE_DIRS = ("ops", "layers", "parallel", "schedule")
+
+# obs.registry metric classes: construction belongs to the registry
+# (obs/ is the whole allowed subtree — registry.py constructs, spans.py
+# and instrument.py are the instrumentation home)
+METRIC_CLASSES = ("LatencyHistogram", "Counter", "Gauge")
+METRIC_MODULES = (
+    "distributed_embeddings_tpu.obs.registry",
+    "distributed_embeddings_tpu.obs",
+    "distributed_embeddings_tpu.utils.metrics",   # the re-export
+)
+METRIC_ALLOWED_DIR = "obs"
 
 _ALLOW_RE = re.compile(
     r'#.*?lint:\s*allow\(([\w-]+(?:\s*,\s*[\w-]+)*)\)')
@@ -124,6 +146,7 @@ def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
     check_hot = pkg_rel not in HOT_ALLOWED
     check_clock = in_package and pkg_rel.split(os.sep)[0] in \
         JIT_MODULE_DIRS
+    check_metric = pkg_rel.split(os.sep)[0] != METRIC_ALLOWED_DIR
 
     # ---- import tracking, so from-imports and aliases cannot evade the
     # rules: `from jax.lax import all_to_all`, `import jax.lax as jl`,
@@ -132,8 +155,25 @@ def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
     lax_modules = {"lax", "jax.lax"}   # names that mean the lax module
     clock_names = {}      # local name -> canonical 'time.time' chain
     clock_modules = {}    # local module alias -> 'time' | 'datetime'
+    metric_names = {}     # local name -> metric class name
+    metric_modules = set()  # local aliases that mean a metric module
     for node in ast.walk(tree):
         if isinstance(node, ast.ImportFrom):
+            if node.module in METRIC_MODULES:
+                for a in node.names:
+                    if a.name in METRIC_CLASSES:
+                        metric_names[a.asname or a.name] = a.name
+                    elif a.name in ("registry", "metrics"):
+                        metric_modules.add(a.asname or a.name)
+            elif node.module in ("distributed_embeddings_tpu.utils",
+                                 "distributed_embeddings_tpu.obs"):
+                for a in node.names:
+                    if a.name in ("metrics", "registry"):
+                        metric_modules.add(a.asname or a.name)
+            elif node.module == "distributed_embeddings_tpu":
+                for a in node.names:
+                    if a.name == "obs":
+                        metric_modules.add(a.asname or "obs")
             if node.module == "jax.lax":
                 for a in node.names:
                     if a.name in COLLECTIVES:
@@ -157,6 +197,12 @@ def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
                     lax_modules.add(a.asname)
                 elif a.name in ("time", "datetime"):
                     clock_modules[a.asname or a.name] = a.name
+                elif a.name in METRIC_MODULES:
+                    # `import ...obs.registry as r` -> r.Counter(...);
+                    # unaliased deep imports resolve through the chain's
+                    # last segment below
+                    metric_modules.add(a.asname or a.name.rsplit(
+                        ".", 1)[-1])
 
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):
@@ -186,6 +232,16 @@ def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
                      f"{chain}() in a jitted-code module — a traced "
                      "wall-clock read freezes one timestamp into the "
                      "compiled program; time at the driver layer")
+            shadow = (chain in metric_names) or (
+                leaf in METRIC_CLASSES and base
+                and base.split(".")[-1] in metric_modules)
+            if check_metric and shadow:
+                emit("shadow-metric", node,
+                     f"{chain}(...) outside obs/ — metric instruments "
+                     "come from a MetricRegistry "
+                     "(registry.histogram/counter/gauge), one namespace "
+                     "the snapshot/SLO layer can see; no shadow "
+                     "accounting")
         elif isinstance(node, ast.Subscript) and check_hot:
             sl = node.slice
             if isinstance(sl, ast.Constant) and sl.value == "hot":
