@@ -286,8 +286,12 @@ MEASURED_DEFAULTS_MIN_MARGIN = 1.03
 
 _AB_ARM_KEYS = {
     # per workload: (non-tiled arm ms keys, tiled arm ms keys, fwd+bwd key)
+    # the ISSUE 12 fused arms count as NON-tiled competitors: a tiled
+    # defaults flip must beat them too (flips to 'pallas' itself stay a
+    # human decision until the kernels mode earns a TPU number)
     "tiny": (("tiny_ab_default_ms", "tiny_ab_pallas_ms", "tiny_ab_cumsum_ms",
-              "tiny_ab_pallas_scatter_ms"),
+              "tiny_ab_pallas_scatter_ms", "tiny_ab_pallas_fused_ms",
+              "tiny_ab_pallas_fused_full_ms"),
              ("tiny_ab_tiled_ms", "tiny_ab_tiled_full_ms"),
              "tiny_ab_tiled_full_ms"),
     "dlrm": (("dlrm_ab_sort_ms", "dlrm_ab_cumsum_ms", "dlrm_ab_dense_ms"),
@@ -1750,6 +1754,207 @@ def ingest_main(argv=None) -> int:
     return 0 if "ingest_error" not in record else 1
 
 
+# --------------------------------------------------------------- kernels
+def run_kernels_bench(vocab: int = 65536, width: int = 32,
+                      batch: int = 4096, hotness: int = 4, iters: int = 5,
+                      optimizer: str = "adagrad", parity_steps: int = 3,
+                      seed: int = 0) -> dict:
+    """Fused-sparse-path kernel A/B (ISSUE 12): xla vs tiled vs pallas
+    arms for the fused forward (DET_LOOKUP_PATH) and the fused
+    backward+optimizer (DET_SCATTER_IMPL strategy), single chip, shared
+    weights/data, slope-timed via `_slope_time_scan`.
+
+    Three claims per record:
+      * parity — per-step losses of each update arm against the 'sort'
+        strategy from the same init/data (`kernels_parity_*`; the pallas
+        arm's marker must be 0.0 — the bit-exactness gate — while the
+        tiled arm documents its f32-tolerance contract) and the forward
+        arms' max output deviation vs the XLA gather+einsum;
+      * time — slope-timed forward-only and full-step times per arm.
+        HONESTY NOTE: on CPU every Pallas arm runs the kernels in
+        INTERPRET mode — a structural understatement of orders of
+        magnitude (the grid executes as emulated XLA ops, nothing runs
+        on an MXU) — so CPU arm times are schema/parity evidence ONLY;
+        the record says so (`kernels_cpu_note`) and the TPU decision is
+        deferred to the tunnel queue (ROADMAP standing item);
+      * projection — the perf_model.md reference-shape predictions the
+        next tunnel window must settle (`kernels_tpu_projections`),
+        stamped verbatim so the falsifiable numbers ride with the arms
+        that will measure them.
+    """
+    from distributed_embeddings_tpu.utils.profiling import fetch_sync
+    devs = jax.devices()
+    record = {
+        "metric": "kernels_fused_ab", "backend": devs[0].platform,
+        "kernels_vocab": vocab, "kernels_width": width,
+        "kernels_batch": batch, "kernels_hotness": hotness,
+        "kernels_iters": iters, "kernels_optimizer": optimizer,
+        "git_sha": _git_sha(),
+        "kernels_cpu_note": (
+            "CPU arms run the Pallas kernels in INTERPRET mode — a "
+            "structural understatement (emulated grid, no MXU); CPU "
+            "times are schema/parity evidence only, the step-time claim "
+            "is decided by this mode at the next tunnel window"),
+        # docs/perf_model.md 'Fused sparse path' — the falsifiable
+        # per-arm TPU predictions this mode settles on hardware
+        "kernels_tpu_projections": {
+            "dlrm_fused_fwd_ms": 5.0,
+            "dlrm_fused_bwd_opt_ms": 7.5,
+            "dlrm_step_ms": 25.0, "dlrm_step_ms_measured_xla": 169.0,
+            "tiny_fused_fwd_ms": 30.0, "tiny_fused_fwd_ms_measured": 120.0,
+            "tiny_fused_bwd_opt_ms": 58.0,
+            "tiny_bwd_opt_ms_measured_xla_sort": 1228.0,
+        },
+    }
+    _ha = _load_hlo_audit()
+    rng = np.random.RandomState(seed)
+    nb = 2
+    raw_batches = []
+    for _ in range(nb):
+        cats = [jnp.asarray(rng.randint(0, vocab, size=(batch, hotness))
+                            .astype(np.int32))]
+        lab = jnp.asarray(rng.randn(batch).astype(np.float32))
+        raw_batches.append((jnp.zeros((batch, 1), jnp.float32), cats, lab))
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
+                           *[(n, tuple(c), l) for (n, c, l) in raw_batches])
+    key = jax.random.PRNGKey(seed)
+
+    def build_model():
+        m = _ha._build_model(vocab, width, "sum", tables=1, mesh=None,
+                             dense_head=True)
+        m._head_width = _ha._head_params(1, width, hotness, "sum")
+        return m
+
+    # ---- forward arms: xla gather+einsum vs tiled vs fused ------------
+    # the parity reference is pinned to the XLA arm: if it failed, the
+    # deviation keys are omitted rather than silently rebased onto
+    # whichever arm happened to succeed first
+    fwd_ref = None
+    for arm, env in (("xla", {"DET_LOOKUP_PATH": "xla"}),
+                     ("tiled", {"DET_LOOKUP_PATH": "tiled"}),
+                     ("fused", {"DET_LOOKUP_PATH": "fused"})):
+        for k, v in env.items():
+            os.environ[k] = v
+        try:
+            model = build_model()
+            emb = model.embedding
+            params = {"embedding": emb.init(key)}
+            cats0 = raw_batches[0][1]
+            fwd = jax.jit(lambda p, c, e=emb: e.apply(p["embedding"],
+                                                      list(c)))
+            out = fwd(params, cats0)
+            fetch_sync(out)
+            t0 = time.perf_counter()
+            fetch_sync(fwd(params, cats0))
+            t1 = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            fetch_sync(fwd(params, cats0))
+            fetch_sync(fwd(params, cats0))
+            t2 = time.perf_counter() - t0
+            record[f"kernels_fwd_{arm}_ms"] = round(
+                max(t2 - t1, 1e-9) * 1e3, 3)
+            o = np.asarray(jax.device_get(out[0]))
+            if arm == "xla":
+                fwd_ref = o
+            elif fwd_ref is not None:
+                record[f"kernels_fwd_{arm}_max_dev"] = float(
+                    np.max(np.abs(o - fwd_ref)))
+        except Exception as e:  # noqa: BLE001 - an arm must not kill it
+            record[f"kernels_fwd_{arm}_error"] = str(e)[:200]
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+
+    # ---- update arms: full sparse step, strategy A/B ------------------
+    parity_losses = {}
+    for arm in ("sort", "tiled", "pallas"):
+        try:
+            model = build_model()
+            init_fn, step_fn = make_sparse_train_step(
+                model, optimizer, lr=0.05, strategy=arm)
+            params = {"embedding": model.embedding.init(key),
+                      "head": model._head_width}
+            state = init_fn(params)
+            losses = []
+            p, s = params, state
+            for i in range(parity_steps):
+                num, cats, lab = raw_batches[i % nb]
+                p, s, loss = step_fn(p, s, num, list(cats), lab)
+                losses.append(float(loss))
+            parity_losses[arm] = losses
+            model = build_model()
+            init_fn, step_fn = make_sparse_train_step(
+                model, optimizer, lr=0.05, strategy=arm)
+            params = {"embedding": model.embedding.init(key),
+                      "head": model._head_width}
+            dt, _, raw = _slope_time_scan(step_fn, params,
+                                          init_fn(params), stacked, nb,
+                                          iters)
+            record[f"kernels_step_{arm}_ms"] = round(dt * 1e3, 3)
+            record[f"kernels_step_{arm}_raw"] = raw
+        except Exception as e:  # noqa: BLE001
+            record[f"kernels_step_{arm}_error"] = str(e)[:300]
+    if "sort" in parity_losses:
+        base = np.asarray(parity_losses["sort"])
+        for arm in ("tiled", "pallas"):
+            if arm in parity_losses:
+                record[f"kernels_parity_max_dev_{arm}"] = float(
+                    np.max(np.abs(np.asarray(parity_losses[arm]) - base)))
+        record["kernels_parity_steps"] = parity_steps
+        # the bit-exactness gate: the fused strategy must REPRODUCE the
+        # sort strategy's losses, not approximate them
+        record["kernels_pallas_bitexact"] = (
+            record.get("kernels_parity_max_dev_pallas") == 0.0)
+    # sort-count fingerprint of the arms being timed (lowering only)
+    try:
+        record["kernels_hlo_sort_audit"] = [
+            _ha.audit_tapped_step(vocab=vocab, width=width,
+                                  optimizer=optimizer, strategy="pallas"),
+            _ha.audit_tapped_step(vocab=vocab, width=width,
+                                  optimizer=optimizer, strategy="pallas",
+                                  lookup_path="fused"),
+        ]
+    except Exception as e:  # noqa: BLE001
+        record["kernels_hlo_sort_audit_error"] = str(e)[:200]
+    return record
+
+
+def kernels_main(argv=None) -> int:
+    """`bench.py --mode kernels` entry point: one JSON line."""
+    import argparse
+    p = argparse.ArgumentParser(description="fused sparse-path kernel A/B")
+    p.add_argument("--mode", choices=["kernels"], default="kernels")
+    p.add_argument("--vocab", type=int, default=65536)
+    p.add_argument("--width", type=int, default=32)
+    p.add_argument("--batch", type=int, default=4096)
+    p.add_argument("--hotness", type=int, default=4)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--parity_steps", type=int, default=3)
+    p.add_argument("--optimizer", default="adagrad",
+                   choices=["sgd", "adagrad", "adam"])
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+        # virtual world so the per-record audit stamp can lower the
+        # meshed program matrix (the kernel arms themselves are 1-chip)
+        _load_hlo_audit()._ensure_world(8)
+    _isolate_from_measured_defaults()
+    try:
+        record = run_kernels_bench(
+            vocab=args.vocab, width=args.width, batch=args.batch,
+            hotness=args.hotness, iters=args.iters,
+            optimizer=args.optimizer, parity_steps=args.parity_steps,
+            seed=args.seed)
+    except Exception as e:  # noqa: BLE001 - one JSON line, like main()
+        import traceback
+        traceback.print_exc()
+        record = {"metric": "kernels_fused_ab",
+                  "kernels_error": str(e)[:300], "git_sha": _git_sha()}
+    print(json.dumps(_stamp_metrics_snapshot(_stamp_audit_findings(record))))
+    return 0 if "kernels_error" not in record else 1
+
+
 # ---------------------------------------------------------------- roofline
 # v5e per-chip peaks (public spec); used only for the efficiency estimate.
 HBM_GBPS = {"v5e": 819.0, "v5p": 2765.0, "v4": 1228.0}
@@ -2154,10 +2359,24 @@ def main():
                 ("tiny_ab_cumsum", {"DET_DEDUP_IMPL": "cumsum"},
                  None, "xla+cumsum-dedup"),
                 # per-row DMA RMW scatter (round 3; gated on hardware
-                # validation — r03 toolchain rejected all DMA kernels)
-                ("tiny_ab_pallas_scatter", {"DET_SCATTER_IMPL": "pallas"},
+                # validation — r03 toolchain rejected all DMA kernels;
+                # 'pallas' now names the fused deduped-row strategy, the
+                # DMA family moved to 'pallas-dma')
+                ("tiny_ab_pallas_scatter",
+                 {"DET_SCATTER_IMPL": "pallas-dma"},
                  sparse_update.prevalidate_pallas_scatter,
                  "pallas-rmw-scatter"),
+                # ISSUE 12 fused sparse path: exact dedup + one tile-walk
+                # RMW stream per bucket (gated per (backend, width class))
+                ("tiny_ab_pallas_fused", {"DET_SCATTER_IMPL": "pallas"},
+                 lambda: sparse_update.prevalidate_pallas_fused(16),
+                 "pallas-fused-rows"),
+                # fully fused: gather->combine forward + fused update
+                ("tiny_ab_pallas_fused_full",
+                 {"DET_SCATTER_IMPL": "pallas",
+                  "DET_LOOKUP_PATH": "fused"},
+                 lambda: sparse_update.prevalidate_pallas_fused(16),
+                 "pallas-fused-fwd+bwd"),
                 # round-4 tiled one-hot-matmul kernels: BlockSpec streams
                 # only, aggregation on the MXU (ops/pallas_tiled.py)
                 ("tiny_ab_tiled", {"DET_SCATTER_IMPL": "tiled"},
@@ -2225,6 +2444,8 @@ if __name__ == "__main__":
         sys.exit(vocab_main(sys.argv[1:]))
     elif _cli_mode() == "lookahead":
         sys.exit(lookahead_main(sys.argv[1:]))
+    elif _cli_mode() == "kernels":
+        sys.exit(kernels_main(sys.argv[1:]))
     elif os.environ.get("DET_BENCH_INNER") == "1":
         main()
     else:

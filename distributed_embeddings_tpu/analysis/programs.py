@@ -175,13 +175,13 @@ class Program:
 
 
 def _lower_step(model, optimizer: str, donate: bool, batch: int,
-                hotness: int, tables: int):
+                hotness: int, tables: int, strategy: str = "auto"):
     import jax
     import jax.numpy as jnp
     from ..training import make_sparse_train_step
     emb = model.embedding
     init_fn, step_fn = make_sparse_train_step(
-        model, optimizer, lr=0.01, donate=donate)
+        model, optimizer, lr=0.01, donate=donate, strategy=strategy)
     params = {"embedding": emb.init(jax.random.PRNGKey(0))}
     if hasattr(model, "_head_width"):
         params["head"] = model._head_width
@@ -231,7 +231,8 @@ def program_matrix(vocab: int = 4096, width: int = 16, tables: int = 4,
     donate = default_donate()
     programs: List[Program] = []
 
-    def steps(name, wire, vocab_, slack=0, weighted=False):
+    def steps(name, wire, vocab_, slack=0, weighted=False,
+              strategy="auto", sort_bound=None):
         model = build_model(vocab_, width, "sum", tables=tables,
                             mesh=mesh, exchange_wire=wire,
                             dense_head=True, vocab_slack=slack,
@@ -239,11 +240,12 @@ def program_matrix(vocab: int = 4096, width: int = 16, tables: int = 4,
         emb = model.embedding
         model._head_width = head_params(tables, width, hotness, "sum")
         lowered, _, _ = _lower_step(model, optimizer, donate, batch,
-                                    hotness, tables)
+                                    hotness, tables, strategy=strategy)
         wires, id_wires, n_groups = _plan_wires(emb)
         ctx = PlanContext(
             program=name, wire_dtypes=wires, id_wire_dtypes=id_wires,
-            sort_bound=n_groups, donate_expected=donate,
+            sort_bound=(n_groups if sort_bound is None else sort_bound),
+            donate_expected=donate,
             overlap={"max_candidates": 0},
             expected_bytes=expected_collective_bytes(
                 emb, [hotness] * tables, batch, weighted=weighted,
@@ -260,6 +262,21 @@ def program_matrix(vocab: int = 4096, width: int = 16, tables: int = 4,
     # 3: vocab-slack plan (ISSUE 7's growth rows; big vocab -> int32 id
     # wire, so both narrowing verdicts are represented in the matrix)
     steps("vocab_slack_step", "f32", 40_000, slack=256)
+
+    # 3b+3c (ISSUE 12): the monolithic model under the tiled and the
+    # fused pallas scatter strategies. The tiled arm is the baseline the
+    # fused arm is measured against: the pallas arm's sort bound is the
+    # tiled lowering's MEASURED sort count (zero extra sorts — its dedup
+    # must consume the folded forward sort, never add one), and both
+    # arms carry the exact padding-report byte model (zero collective
+    # deltas — the update strategy must not change what moves on the
+    # wire; the collective-bytes pass asserts compiled == model exactly
+    # on each). tools/hlo_audit.py's mutation fixture
+    # 'pallas-arm-extra-sort' proves this arm can fail.
+    steps("monolithic_tiled", "f32", vocab, strategy="tiled")
+    tiled_sorts = ir.op_counts(programs[-1].module, ops=("sort",))["sort"]
+    steps("pallas_strategy_step", "f32", vocab, strategy="pallas",
+          sort_bound=tiled_sorts)
 
     # 4+5: lookahead fused + prefetch from the SAME model as the
     # monolithic arm — the fused step's prefetch collectives must all be
@@ -459,6 +476,14 @@ def mutation_cases() -> List[MutationCase]:
             ctx=PlanContext(program="mutation", sort_bound=1),
             expect_fids=("op-counts/sort-over-bound",)),
         MutationCase(
+            name="pallas-arm-extra-sort", pass_name="op-counts",
+            text=_MUT_TWO_SORTS,
+            # the ISSUE 12 pallas-strategy arm's gate, seeded violated: a
+            # fused step that re-sorts past the tiled baseline's measured
+            # count must flag (blind-gate discipline — the arm can fail)
+            ctx=PlanContext(program="pallas_strategy_step", sort_bound=1),
+            expect_fids=("op-counts/sort-over-bound",)),
+        MutationCase(
             name="bf16-bytes-on-f32-wire", pass_name="collective-bytes",
             text=_MUT_BF16_ON_F32_WIRE,
             ctx=PlanContext(program="mutation", wire_dtypes=("f32",)),
@@ -553,9 +578,10 @@ def audit_tapped_step(vocab: int = 30_000_000, width: int = 8,
         else:
             os.environ["DET_LOOKUP_PATH"] = prev
     # the bound the fold ships under: one canonical sort per exchange
-    # group, plus the tiled forward gather's inverse-permute sort (the one
-    # residual sort — scatter-free inversion needs a second sort op)
-    bound = n_groups * (2 if lookup_path == "tiled" else 1)
+    # group, plus the tiled/fused forward gather's inverse-permute sort
+    # (the one residual sort — scatter-free inversion needs a second
+    # sort op; the fused gather->combine consumes the same artifact)
+    bound = n_groups * (2 if lookup_path in ("tiled", "fused") else 1)
     return {
         "optimizer": optimizer, "strategy": strategy,
         "lookup_path": lookup_path or "default", "fold": fold,

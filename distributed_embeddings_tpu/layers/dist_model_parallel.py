@@ -224,20 +224,20 @@ def _capture_fd2(out: dict):
         cap.close()
 
 
-_TILED_INTERPRET_WARNED = [False]
+_INTERPRET_WARNED: set = set()
 
 
-def _warn_tiled_interpret_once() -> None:
-    """DET_LOOKUP_PATH=tiled off-TPU runs the Pallas kernels in interpret
-    mode — orders of magnitude slower than the XLA path. Fine for the
-    equivalence tests that set it deliberately; say so once anywhere else
-    (ADVICE r4)."""
-    if _TILED_INTERPRET_WARNED[0]:
+def _warn_interpret_once(path: str) -> None:
+    """DET_LOOKUP_PATH=tiled/fused off-TPU runs the Pallas kernels in
+    interpret mode — orders of magnitude slower than the XLA path. Fine
+    for the equivalence tests that set it deliberately; say so once per
+    path anywhere else (ADVICE r4)."""
+    if path in _INTERPRET_WARNED:
         return
-    _TILED_INTERPRET_WARNED[0] = True
+    _INTERPRET_WARNED.add(path)
     import warnings
     warnings.warn(
-        "DET_LOOKUP_PATH=tiled on a non-TPU backend: the tiled Pallas "
+        f"DET_LOOKUP_PATH={path} on a non-TPU backend: this Pallas "
         "lookup runs in INTERPRET mode here (correct but very slow — "
         "intended for tests). Unset DET_LOOKUP_PATH or run on TPU.",
         RuntimeWarning, stacklevel=3)
@@ -446,8 +446,9 @@ class DistributedEmbedding:
         # traced forwards then consult the cached verdict
         from distributed_embeddings_tpu.ops.sparse_update import (
             measured_default, prevalidate_active_impl)
-        if measured_default("DET_LOOKUP_PATH", "auto") == "tiled":
-            prevalidate_active_impl()
+        if measured_default("DET_LOOKUP_PATH", "auto") in ("tiled",
+                                                          "fused"):
+            prevalidate_active_impl(widths=self.plan_widths())
         # mixed precision (reference tests' mixed_precision_policy,
         # dist_model_parallel_test.py:30-34): params stay fp32, the lookup
         # outputs / combines / collectives run in compute_dtype (e.g. bf16).
@@ -502,6 +503,16 @@ class DistributedEmbedding:
                     "but this backend exposes no host memory space: "
                     "offloaded buckets remain device-resident and count "
                     "against device memory.", RuntimeWarning, stacklevel=2)
+
+    def plan_widths(self) -> tuple:
+        """The distinct table lane widths of this plan (tp buckets + row
+        slices) — THE one derivation of what `sparse_update.
+        prevalidate_active_impl` must compile-probe the shape-classed
+        pallas gate at (a width class never probed eagerly can never
+        validate under the jit trace). Shared by this constructor and the
+        train-step/engine factories."""
+        return tuple(sorted({b.width for b in self.plan.tp_buckets}
+                            | {rt.width for rt in self.plan.row_tables}))
 
     # ------------------------------------------------------------------ init
     def _tp_shard(self, key, b: int, rank: int) -> jax.Array:
@@ -1113,13 +1124,17 @@ class DistributedEmbedding:
         return scope()
 
     def _fwd_tiled_active(self, bucket, k: int) -> bool:
-        """Will `_group_lookup` take the tiled Pallas gather for this
-        (bucket, hotness)? Mirrors its dispatch statically (trace-safe)."""
+        """Will `_group_lookup` take a sorted-gather Pallas path (tiled
+        or the ISSUE 12 fused gather->combine) for this (bucket,
+        hotness)? Mirrors its dispatch statically (trace-safe) — both
+        paths consume the residual sort's inverse permutation."""
         path = sparse_update_ops.measured_default("DET_LOOKUP_PATH", "auto")
-        if path != "tiled" or not self.use_custom_kernel:
+        if path not in ("tiled", "fused") or not self.use_custom_kernel:
             return False
         if bucket.combiner is None and k != 1:
-            return False       # flatten path; no tiled gather
+            return False       # flatten path; no sorted gather
+        if path == "fused":
+            return sparse_update_ops.pallas_fwd_ok_static(bucket.width)
         return sparse_update_ops.tiled_fwd_ok_static()
 
     def _sort_plan(self, groups, spec) -> List[Optional[str]]:
@@ -1201,8 +1216,32 @@ class DistributedEmbedding:
         """
         b_sz, f, k = ids.shape
         path = sparse_update_ops.measured_default("DET_LOOKUP_PATH", "auto")
-        if combiner is None and k == 1 and path in ("pallas", "tiled"):
+        if combiner is None and k == 1 and path in ("pallas", "tiled",
+                                                    "fused"):
             combiner = "sum"     # identical result at hotness 1
+        if (path == "fused" and combiner in ("sum", "mean")
+                and self.use_custom_kernel):
+            # ISSUE 12 fused gather->combine (ops/pallas_tiled.
+            # fused_lookup_combine): one weighted-gather kernel pass +
+            # scatter-free unpermute + plain hotness sum, replacing the
+            # descriptor-bound XLA table gather AND the separate combine
+            # einsum. Compiled use requires the eager shape-class gate
+            # (prevalidate_active_impl); off-TPU it runs in interpret
+            # mode (tests). The constructor opt-out wins over the knob.
+            from distributed_embeddings_tpu.ops import (pallas_tiled,
+                                                        sparse_update)
+            if not pallas_lookup.is_tpu_backend():
+                _warn_interpret_once("fused")
+            if sparse_update.pallas_kernels_ok(table):
+                w = (weights if weights is not None
+                     else jnp.ones((b_sz, f, k), jnp.float32))
+                ps = None
+                if presorted is not None and presorted.inv is not None:
+                    ps = (presorted.sid, presorted.perm, presorted.inv)
+                out = pallas_tiled.fused_lookup_combine(
+                    table, ids.reshape(b_sz * f, k), w.reshape(b_sz * f, k),
+                    combiner, presorted=ps)
+                return self._cast(out.reshape(b_sz, f, out.shape[-1]))
         if (path == "tiled" and combiner in ("sum", "mean")
                 and self.use_custom_kernel):
             # round-4 tiled one-hot-matmul gather (ops/pallas_tiled.py):
@@ -1215,7 +1254,7 @@ class DistributedEmbedding:
             from distributed_embeddings_tpu.ops import (pallas_tiled,
                                                         sparse_update)
             if not pallas_lookup.is_tpu_backend():
-                _warn_tiled_interpret_once()
+                _warn_interpret_once("tiled")
             if sparse_update.tiled_kernels_ok(table):
                 w = (weights if weights is not None
                      else jnp.ones((b_sz, f, k), jnp.float32))

@@ -22,12 +22,12 @@ from distributed_embeddings_tpu.obs.slo import (  # noqa: F401
 from distributed_embeddings_tpu.obs.spans import (  # noqa: F401
     annotation, current_span, span)
 from distributed_embeddings_tpu.obs.instrument import (  # noqa: F401
-    export_exchange_gauges)
+    export_exchange_gauges, export_kernel_gauges)
 
 __all__ = [
     "Counter", "Gauge", "LatencyHistogram", "MetricRegistry",
     "default_registry", "reset_default_registry", "metric_key",
     "span", "annotation", "current_span",
     "load_rules", "evaluate_rules", "metric_value", "summarize",
-    "export_exchange_gauges",
+    "export_exchange_gauges", "export_kernel_gauges",
 ]

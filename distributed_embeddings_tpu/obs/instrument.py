@@ -14,8 +14,23 @@ from typing import Optional
 
 from distributed_embeddings_tpu.obs.registry import MetricRegistry
 
-__all__ = ["export_exchange_gauges", "EXCHANGE_GAUGE_FIELDS",
-           "EXCHANGE_GROUP_GAUGE_FIELDS"]
+__all__ = ["export_exchange_gauges", "export_kernel_gauges",
+           "EXCHANGE_GAUGE_FIELDS", "EXCHANGE_GROUP_GAUGE_FIELDS"]
+
+
+def export_kernel_gauges(registry: MetricRegistry) -> dict:
+    """Set ``kernels/gate_verdict{impl=}`` gauges from the sparse-update
+    kernel gates (ISSUE 12): 1 = hardware-validated, 0 = probe failed,
+    -1 = never probed (off-TPU interpret mode / impl never requested).
+    ``tools/slo_tier1.json`` requires the pallas verdict's PRESENCE, so
+    a run that forgot this wiring fails the smoke loudly rather than
+    shipping a snapshot that cannot say which kernel family ran.
+    Returns the verdict dict."""
+    from distributed_embeddings_tpu.ops.sparse_update import gate_verdicts
+    verdicts = gate_verdicts()
+    for impl, verdict in verdicts.items():
+        registry.gauge("kernels/gate_verdict", impl=impl).set(verdict)
+    return verdicts
 
 # top-level report fields exported as exchange/<field> gauges
 EXCHANGE_GAUGE_FIELDS = (

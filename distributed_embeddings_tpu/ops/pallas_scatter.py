@@ -27,7 +27,9 @@ updated.
 
 Status: interpret-mode correct (tests/test_pallas_scatter.py); compiled
 use is gated on `sparse_update.prevalidate_pallas_scatter()`. Dispatch
-lives in sparse_update._row_scatter_add behind DET_SCATTER_IMPL=pallas.
+lives in sparse_update._row_scatter_add behind DET_SCATTER_IMPL=pallas-dma
+(the 'pallas' value now names the fused deduped-row tile-walk strategy,
+ISSUE 12 — this DMA family keeps its own gate for a future toolchain).
 """
 
 import functools

@@ -50,9 +50,14 @@ Semantics contract (shared by all entry points):
   * aggregation order differs from XLA's scatter order, so results match
     to f32 tolerance, not bit-exactly (tests pin ~1e-5 relative).
 
-Status: interpret-mode tested everywhere (tests/test_pallas_tiled.py);
-compiled use is gated on `prevalidate_tiled()` against the attached chip.
-Dispatch lives in sparse_update behind DET_SCATTER_IMPL=tiled.
+Status: interpret-mode tested everywhere (tests/test_pallas_tiled.py,
+tests/test_pallas_fused.py); compiled use is gated on
+`prevalidate_tiled()` / `prevalidate_pallas_fused()` against the
+attached chip. Dispatch lives in sparse_update behind
+DET_SCATTER_IMPL=tiled (raw-stream kernels, f32-tolerance parity) and
+DET_SCATTER_IMPL=pallas (the ISSUE 12 fused strategy: deduped-row
+appliers + the weighted gather->combine forward, bit-exact vs the XLA
+sort path — see the fused section below).
 """
 
 import functools
@@ -64,11 +69,34 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# shared rounding pin (see its docstring): the in-kernel optimizer
+# arithmetic must round at exactly the seams the XLA sort path rounds at
+# (scatter-operand materialization / the pinned adam products), or
+# context-dependent backend FMA contraction breaks the fused strategy's
+# bit-exactness. Every kernel's hp block carries a trailing RUNTIME 0.0
+# (an SMEM load the compiler cannot prove constant) as the pin operand.
+from distributed_embeddings_tpu.ops.sparse_update import (fp_round,
+                                                          round_pin)
+
+
+# Process-cached backend probe (ISSUE 12 satellite bugfix): the default
+# interpret decision used to re-consult jax.default_backend() on every
+# kernel call, so a backend flip mid-process (config update between the
+# forward trace and the update trace) could run one step's phases in
+# DIFFERENT modes. One probe per process; every entry point — the
+# optimizer kernels, the row appliers AND tiled_gather_sorted — shares
+# the cached verdict, so forward and update phases of one step can never
+# diverge. An explicit interpret= argument always wins.
+_BACKEND_INTERPRET: Optional[bool] = None
+
 
 def _interpret_default(interpret: Optional[bool]) -> bool:
+    global _BACKEND_INTERPRET
     if interpret is None:
-        return jax.default_backend() != "tpu"
-    return interpret
+        if _BACKEND_INTERPRET is None:
+            _BACKEND_INTERPRET = jax.default_backend() != "tpu"
+        return _BACKEND_INTERPRET
+    return bool(interpret)
 
 
 # defaults; wrappers shrink them for tiny shapes. tile bounds VMEM
@@ -224,8 +252,10 @@ def _sgd_kernel(tof_ref, cof_ref, ids_ref, grads_ref, hp_ref, table_ref,
     @pl.when(last)
     def _():
         lr = hp_ref[0, 0]
+        zero = hp_ref[0, 1]         # rounding pin (see fp_round)
         out_ref[:] = (table_ref[:].astype(jnp.float32)
-                      - lr * acc_ref[:]).astype(out_ref.dtype)
+                      - fp_round(lr * acc_ref[:], zero)).astype(
+                          out_ref.dtype)
 
 
 def _adagrad_kernel(tof_ref, cof_ref, ids_ref, grads_ref, hp_ref, table_ref,
@@ -250,13 +280,14 @@ def _adagrad_kernel(tof_ref, cof_ref, ids_ref, grads_ref, hp_ref, table_ref,
     @pl.when(last)
     def _():
         lr = hp_ref[0, 0]
+        zero = hp_ref[0, 1]         # rounding pin (see fp_round)
         gs = acc_ref[:]
-        a_new = accum_ref[:].astype(jnp.float32) + gs * gs
+        a_new = accum_ref[:].astype(jnp.float32) + fp_round(gs * gs, zero)
         out_a_ref[:] = a_new.astype(out_a_ref.dtype)
         # untouched rows: gs == 0 -> delta == 0, accumulator unchanged
         out_t_ref[:] = (table_ref[:].astype(jnp.float32)
-                        - lr * gs * lax.rsqrt(a_new + eps)).astype(
-                            out_t_ref.dtype)
+                        - fp_round(lr * gs * lax.rsqrt(a_new + eps),
+                                   zero)).astype(out_t_ref.dtype)
 
 
 def _update_call(kernel, n_out, table, extra_tables, sid, rows, hp,
@@ -322,6 +353,19 @@ def _shrink(vocab: int, n: int, chunk: int, tile: int):
     return chunk, tile
 
 
+def _hp_with_pin(ids, lr, *extra):
+    """SMEM hyperparameter block [1, n]: lr, any extra scalars, then the
+    RUNTIME 0.0 every kernel reads as its rounding pin (see fp_round).
+    The pin derives from the id stream — lr is usually a trace-time
+    constant, and a constant hp block would let the backend fold the pin
+    away; ids are traced in every real flow, which keeps the SMEM slot
+    opaque."""
+    vals = [jnp.asarray(lr, jnp.float32).reshape(())]
+    vals += [jnp.asarray(e, jnp.float32).reshape(()) for e in extra]
+    vals.append(round_pin(ids).reshape(()))
+    return jnp.stack(vals).reshape(1, len(vals))
+
+
 def _sorted_stream(ids, contribs, vocab: int, presorted):
     """(sid, permuted contrib rows) for an update kernel: fresh sort, or a
     caller-provided (sid, perm) — e.g. the forward lookup's sort reused by
@@ -346,7 +390,7 @@ def tiled_sgd(table: jax.Array, ids: jax.Array, contribs: jax.Array, lr,
         return table
     chunk, tile = _shrink(table.shape[0], ids.shape[0], chunk, tile)
     sid, rows = _sorted_stream(ids, contribs, table.shape[0], presorted)
-    hp = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    hp = _hp_with_pin(sid, lr)
     return _update_call(_sgd_kernel, 1, table, [], sid, rows, hp,
                         chunk, tile, interpret)
 
@@ -364,7 +408,7 @@ def tiled_adagrad(table: jax.Array, accum: jax.Array, ids: jax.Array,
         return table, accum
     chunk, tile = _shrink(table.shape[0], ids.shape[0], chunk, tile)
     sid, rows = _sorted_stream(ids, contribs, table.shape[0], presorted)
-    hp = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    hp = _hp_with_pin(sid, lr)
     out = _update_call(functools.partial(_adagrad_kernel, eps=eps), 2,
                        table, [accum], sid, rows, hp, chunk, tile, interpret)
     return out[0], out[1]
@@ -405,11 +449,15 @@ def _adam_kernel(tof_ref, cof_ref, ids_ref, grads_ref, hp_ref, table_ref,
         c2 = hp_ref[0, 2]        # 1 - b2^count
         gs = acc_ref[:]
         touched = cnt_ref[:] > 0.0                        # [tile, 1]
+        zero = hp_ref[0, 3]         # rounding pin (see fp_round)
         mu_old = mu_ref[:].astype(jnp.float32)
         nu_old = nu_ref[:].astype(jnp.float32)
-        mu_new = jnp.where(touched, b1 * mu_old + (1.0 - b1) * gs, mu_old)
-        nu_new = jnp.where(touched, b2 * nu_old + (1.0 - b2) * gs * gs,
-                           nu_old)
+        mu_new = jnp.where(touched, fp_round(b1 * mu_old, zero)
+                           + fp_round((1.0 - b1) * gs, zero), mu_old)
+        nu_new = jnp.where(
+            touched, fp_round(b2 * nu_old, zero)
+            + fp_round((1.0 - b2) * fp_round(gs * gs, zero), zero),
+            nu_old)
         delta = jnp.where(
             touched,
             -lr * (mu_new / c1) / (jnp.sqrt(nu_new / c2) + eps), 0.0)
@@ -437,8 +485,7 @@ def tiled_adam(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
     c2 = 1.0 - lax.pow(jnp.float32(b2), cf)
     chunk, tile = _shrink(table.shape[0], ids.shape[0], chunk, tile)
     sid, rows = _sorted_stream(ids, contribs, table.shape[0], presorted)
-    hp = jnp.stack([jnp.asarray(lr, jnp.float32).reshape(()), c1,
-                    c2]).reshape(1, 3)
+    hp = _hp_with_pin(sid, lr, c1, c2)
     out = _update_call(
         functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps), 3,
         table, [mu, nu], sid, rows, hp, chunk, tile, interpret,
@@ -449,24 +496,34 @@ def tiled_adam(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
 # --------------------------------------------------------------------------
 # gather kernel (chunk-major walk)
 # --------------------------------------------------------------------------
-def _gather_kernel(tof_ref, cof_ref, ids_ref, table_ref, out_ref, *,
-                   tile: int, g_count: int, vocab: int):
+def _gather_kernel(tof_ref, cof_ref, ids_ref, *refs, tile: int,
+                   g_count: int, vocab: int, weighted: bool = False):
+    """Chunk-major gather: out[j] = table[ids[j]] — or, with `weighted`
+    (the ISSUE 12 fused forward), w[j] * table[ids[j]]: the per-lane
+    weight scales the one-hot COLUMN, so the weight multiply is free on
+    the MXU and no separate [N, w] elementwise pass exists."""
+    if weighted:
+        w_ref, table_ref, out_ref = refs
+    else:
+        table_ref, out_ref = refs
     g = pl.program_id(0)
     c = cof_ref[g]
     prev_c = cof_ref[jnp.maximum(g - 1, 0)]
     first = (g == 0) | (prev_c != c)
     t = tof_ref[g]
-    # out[j] = table[ids[j]] : contract the one-hot on the TILE axis.
-    # The last tile's out-of-bounds rows must be zeroed before the
-    # contraction: their buffer content is undefined (NaN in interpret
-    # mode) and 0 * NaN = NaN would poison every output row of the chunk.
-    # (The update kernels don't contract over tile rows, so undefined
-    # tail rows stay confined there and are masked on write-back.)
+    # contract the one-hot on the TILE axis. The last tile's
+    # out-of-bounds rows must be zeroed before the contraction: their
+    # buffer content is undefined (NaN in interpret mode) and
+    # 0 * NaN = NaN would poison every output row of the chunk. (The
+    # update kernels don't contract over tile rows, so undefined tail
+    # rows stay confined there and are masked on write-back.)
     base = t * tile
     r_iota = lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
     valid_row = (base + r_iota) < vocab
     tbl = jnp.where(valid_row, table_ref[:].astype(jnp.float32), 0.0)
     oh = _onehot(ids_ref[0, :], base, tile)              # [tile, chunk]
+    if weighted:
+        oh = oh * w_ref[0, :][None, :]
     part = lax.dot_general(oh, tbl,
                            (((0,), (0,)), ((), ())),     # sum over tile rows
                            precision=lax.Precision.HIGHEST,
@@ -481,6 +538,52 @@ def _gather_kernel(tof_ref, cof_ref, ids_ref, table_ref, out_ref, *,
         out_ref[:] = out_ref[:] + part
 
 
+def _gather_call(table, sid, w_sorted, chunk: int, tile: int, interpret):
+    """Shared pallas_call builder for the chunk-major gather walk; with
+    `w_sorted` the weight stream rides a second chunk-indexed operand
+    into the weighted kernel variant."""
+    vocab, width = table.shape
+    n = sid.shape[0]
+    chunk, tile = _shrink(vocab, n, chunk, tile)
+    kids2d, pad_rows, c_first, c_last, n_chunks = _chunk_layout(
+        sid, vocab, chunk, tile)
+    n_tiles = -(-vocab // tile)
+    tof, cof = _chunk_major_pairs(c_first, c_last, n_tiles, n_chunks)
+    g_count = n_chunks + n_tiles
+    chunk_spec = pl.BlockSpec((1, chunk), lambda g, tof, cof: (cof[g], 0),
+                              memory_space=pltpu.VMEM)
+    operands = [kids2d]
+    in_specs = [chunk_spec]
+    if w_sorted is not None:
+        operands.append(jnp.concatenate(
+            [w_sorted.astype(jnp.float32),
+             jnp.zeros((pad_rows - n,), jnp.float32)]).reshape(
+                 n_chunks + 1, chunk))
+        in_specs.append(chunk_spec)
+    operands.append(table)
+    in_specs.append(pl.BlockSpec((tile, width),
+                                 lambda g, tof, cof: (tof[g], 0),
+                                 memory_space=pltpu.VMEM))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(g_count,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((chunk, width),
+                               lambda g, tof, cof: (cof[g], 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[],
+    )
+    out = pl.pallas_call(
+        functools.partial(_gather_kernel, tile=tile, g_count=g_count,
+                          vocab=vocab, weighted=w_sorted is not None),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            ((n_chunks + 1) * chunk, width), jnp.float32),
+        interpret=_interpret_default(interpret),
+    )(tof, cof, *operands)
+    return out[:n]
+
+
 def tiled_gather_sorted(table: jax.Array, sid: jax.Array,
                         chunk: int = _CHUNK, tile: int = _TILE,
                         interpret: Optional[bool] = None) -> jax.Array:
@@ -490,39 +593,21 @@ def tiled_gather_sorted(table: jax.Array, sid: jax.Array,
     f32. The block walk reads each table tile once per spanning chunk
     (sequential HBM), replacing the ~22 ns/row descriptor-bound XLA gather
     for large sorted batches."""
-    vocab, width = table.shape
-    n = sid.shape[0]
-    if n == 0:
-        return jnp.zeros((0, width), jnp.float32)
-    chunk, tile = _shrink(vocab, n, chunk, tile)
-    kids2d, pad_rows, c_first, c_last, n_chunks = _chunk_layout(
-        sid, vocab, chunk, tile)
-    n_tiles = -(-vocab // tile)
-    tof, cof = _chunk_major_pairs(c_first, c_last, n_tiles, n_chunks)
-    g_count = n_chunks + n_tiles
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(g_count,),
-        in_specs=[
-            pl.BlockSpec((1, chunk), lambda g, tof, cof: (cof[g], 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, width), lambda g, tof, cof: (tof[g], 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((chunk, width),
-                               lambda g, tof, cof: (cof[g], 0),
-                               memory_space=pltpu.VMEM),
-        scratch_shapes=[],
-    )
-    out = pl.pallas_call(
-        functools.partial(_gather_kernel, tile=tile, g_count=g_count,
-                          vocab=vocab),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(
-            ((n_chunks + 1) * chunk, width), jnp.float32),
-        interpret=_interpret_default(interpret),
-    )(tof, cof, kids2d, table)
-    return out[:n]
+    if sid.shape[0] == 0:
+        return jnp.zeros((0, table.shape[1]), jnp.float32)
+    return _gather_call(table, sid, None, chunk, tile, interpret)
+
+
+def _sort_with_inv(flat_ids, vocab: int, presorted):
+    """(sid, perm, inv) of a flat id stream under the canonical key: the
+    caller-provided triple verbatim, or one fresh sort plus the
+    scatter-free second-sort inversion — the ONE derivation the tiled
+    and fused lookups (forward and custom-vjp fwd) all share."""
+    if presorted is not None:
+        return presorted
+    sid, _, perm = _sort_ids(flat_ids, None, vocab)
+    iota = lax.iota(jnp.int32, perm.shape[0])
+    return sid, perm, lax.sort_key_val(perm, iota)[1]
 
 
 def tiled_gather(table: jax.Array, ids: jax.Array,
@@ -534,20 +619,16 @@ def tiled_gather(table: jax.Array, ids: jax.Array,
     reuses a prior (sid, perm) of this id stream."""
     if ids.shape[0] == 0:
         return jnp.zeros((0, table.shape[1]), jnp.float32)
-    inv = None
-    if presorted is None:
-        sid, _, perm = _sort_ids(ids, None, table.shape[0])
-    elif len(presorted) == 3:          # (sid, perm, inv): fully precomputed
-        sid, perm, inv = presorted
-    else:
+    if presorted is not None and len(presorted) == 2:
+        # a 2-tuple carries no inverse: derive it scatter-free (an
+        # .at[perm].set would reintroduce the ~100 ns/row scatter
+        # lowering this whole path exists to avoid — round-3 prims)
         sid, perm = presorted
-    rows = tiled_gather_sorted(table, sid, chunk, tile, interpret)
-    if inv is None:
-        # SCATTER-FREE inverse permutation (second sort + take): an
-        # .at[perm].set would reintroduce the ~100 ns/row scatter lowering
-        # this whole path exists to avoid (round-3 prims)
         iota = lax.iota(jnp.int32, perm.shape[0])
         inv = lax.sort_key_val(perm, iota)[1]
+    else:
+        sid, perm, inv = _sort_with_inv(ids, table.shape[0], presorted)
+    rows = tiled_gather_sorted(table, sid, chunk, tile, interpret)
     return jnp.take(rows, inv, axis=0)
 
 
@@ -555,6 +636,27 @@ def tiled_gather(table: jax.Array, ids: jax.Array,
 # forward lookup-combine on the tiled gather (drop-in for the XLA
 # gather+reduce in DistributedEmbedding._group_lookup)
 # --------------------------------------------------------------------------
+def _combine_prologue(params, ids, weights, combiner, presorted):
+    """Shared lookup-wrapper prologue (tiled + fused): validate the
+    combiner, default/normalize weights (mean pre-divides), clamp ids to
+    XLA gather semantics, and clamp a caller presorted triple's keys the
+    same way (positive OOB ids keep their clamp; NEGATIVE ids — already
+    unspecified in the fused-bucket forward — read row V-1 on these
+    paths instead of row 0)."""
+    if combiner not in ("sum", "mean"):
+        raise ValueError(f"Unsupported combiner {combiner}")
+    if weights is None:
+        weights = jnp.ones(ids.shape, jnp.float32)
+    if combiner == "mean":
+        denom = jnp.maximum(jnp.sum(weights, axis=1, keepdims=True), 1.0)
+        weights = weights / denom
+    ids = jnp.clip(ids, 0, params.shape[0] - 1)
+    if presorted is not None:
+        sid, perm, inv = presorted
+        presorted = (jnp.minimum(sid, params.shape[0] - 1), perm, inv)
+    return ids, weights, presorted
+
+
 def _tiled_lookup_impl(params, ids, weights, interpret, presorted=None):
     b, k = ids.shape
     rows = tiled_gather(params, ids.reshape(-1), interpret=interpret,
@@ -574,12 +676,8 @@ def _tiled_lookup_fwd(params, ids, weights, presorted, interpret):
     # XLA CSE does not merge fwd/bwd sorts — measured round 5). A caller-
     # provided `presorted` (the tapped path's TapResiduals artifact) folds
     # even the forward's own sort away.
-    if presorted is None:
-        sid, _, perm = _sort_ids(ids.reshape(-1), None, params.shape[0])
-        iota = lax.iota(jnp.int32, perm.shape[0])
-        inv = lax.sort_key_val(perm, iota)[1]
-    else:
-        sid, perm, inv = presorted
+    sid, perm, inv = _sort_with_inv(ids.reshape(-1), params.shape[0],
+                                    presorted)
     return (_tiled_lookup_impl(params, ids, weights, interpret,
                                presorted=(sid, perm, inv)),
             (params, ids, weights, sid, perm, inv))
@@ -610,6 +708,167 @@ def _tiled_lookup_bwd(interpret, res, g):
 _tiled_lookup.defvjp(_tiled_lookup_fwd, _tiled_lookup_bwd)
 
 
+# --------------------------------------------------------------------------
+# fused sparse path (ISSUE 12, DET_SCATTER_IMPL=pallas): deduped-row
+# appliers + weighted gather->combine forward
+#
+# The tiled_* kernels above take the RAW contribution stream and
+# aggregate duplicates inside the matmul — results match XLA to f32
+# tolerance (aggregation order differs). The fused strategy instead
+# consumes the EXACT `sparse_update.dedup_sum` aggregation (bit-for-bit
+# the XLA sort path's (rep, sums): unique ascending row ids, per-row
+# totals, OOB fillers >= sentinel) and applies the optimizer as ONE
+# tile-walk RMW stream per bucket. With a unique id stream the one-hot
+# matmul is an exact PLACEMENT — each tile row receives its single total
+# plus exact zeros — and the in-tile optimizer arithmetic mirrors the
+# XLA sort path expression for expression, so the fused update is
+# BIT-exact against it (asserted in tests/test_pallas_fused.py). The
+# rep stream is canonical-sorted by dedup_sum's contract, so no sort
+# happens here: the forward's folded GroupSort is the only sort in the
+# step. Dispatch + gates live in sparse_update behind
+# DET_SCATTER_IMPL=pallas.
+# --------------------------------------------------------------------------
+def _rows_prep(table, rep, sums, chunk: int, tile: int):
+    chunk, tile = _shrink(table.shape[0], rep.shape[0], chunk, tile)
+    return rep.astype(jnp.int32), sums, chunk, tile
+
+
+def tiled_sgd_rows(table: jax.Array, rep: jax.Array, sums: jax.Array, lr,
+                   chunk: int = _CHUNK, tile: int = _TILE,
+                   interpret: Optional[bool] = None) -> jax.Array:
+    """table[rep] -= lr * sums for a canonical-sorted UNIQUE `rep` stream
+    (dedup_sum 'sort' output; fillers >= table rows are dropped).
+    Bit-identical to ``table.at[rep].add(-lr * sums, mode="drop")`` —
+    exact one-hot placement, one table-tile RMW stream. lr may be traced
+    (SMEM scalar)."""
+    if rep.shape[0] == 0:
+        return table
+    rep, sums, chunk, tile = _rows_prep(table, rep, sums, chunk, tile)
+    hp = _hp_with_pin(rep, lr)
+    return _update_call(_sgd_kernel, 1, table, [], rep, sums, hp,
+                        chunk, tile, interpret)
+
+
+def tiled_adagrad_rows(table: jax.Array, accum: jax.Array, rep: jax.Array,
+                       sums: jax.Array, lr, eps: float = 1e-10,
+                       chunk: int = _CHUNK, tile: int = _TILE,
+                       interpret: Optional[bool] = None):
+    """Fused adagrad over deduped rows — one RMW stream reads and writes
+    each touched table+accumulator tile once:
+        acc[r]   += sums[s]^2
+        table[r] -= lr * sums[s] * rsqrt(acc[r] + eps)
+    Bit-identical to sparse_update.sparse_adagrad's 'sort' path (same
+    placement, same expression grouping). Returns (table', accum')."""
+    if rep.shape[0] == 0:
+        return table, accum
+    rep, sums, chunk, tile = _rows_prep(table, rep, sums, chunk, tile)
+    hp = _hp_with_pin(rep, lr)
+    out = _update_call(functools.partial(_adagrad_kernel, eps=eps), 2,
+                       table, [accum], rep, sums, hp, chunk, tile,
+                       interpret)
+    return out[0], out[1]
+
+
+def tiled_adam_rows(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
+                    rep: jax.Array, sums: jax.Array, lr, b1: float = 0.9,
+                    b2: float = 0.999, eps: float = 1e-8,
+                    chunk: int = _CHUNK, tile: int = _TILE,
+                    interpret: Optional[bool] = None):
+    """Fused lazy adam over deduped rows (sparse_update.sparse_adam's
+    touched-row semantics, bit-identical to its 'sort' path): the
+    one-hot count column marks touched rows — a zero TOTAL on a touched
+    row still decays its moments. Returns (table, mu, nu, count)."""
+    count = count + 1
+    if rep.shape[0] == 0:
+        return table, mu, nu, count
+    cf = count.astype(jnp.float32)
+    # exact expression twin of sparse_adam's bias correction
+    c1 = 1.0 - b1 ** cf
+    c2 = 1.0 - b2 ** cf
+    rep, sums, chunk, tile = _rows_prep(table, rep, sums, chunk, tile)
+    hp = _hp_with_pin(rep, lr, c1, c2)
+    out = _update_call(
+        functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps), 3,
+        table, [mu, nu], rep, sums, hp, chunk, tile, interpret,
+        extra_scratch=[pltpu.VMEM((tile, 1), jnp.float32)])
+    return out[0], out[1], out[2], count
+
+
+# --------------------------------------------------------------------------
+# fused forward: weighted gather (chunk-major walk, weights folded into
+# the one-hot so one MXU contraction yields COMBINE-ready rows)
+# --------------------------------------------------------------------------
+def tiled_gather_sorted_weighted(table: jax.Array, sid: jax.Array,
+                                 w_sorted: jax.Array,
+                                 chunk: int = _CHUNK, tile: int = _TILE,
+                                 interpret: Optional[bool] = None
+                                 ) -> jax.Array:
+    """rows[k] = w_sorted[k] * table[sid[k]] for ASCENDING-sorted sid;
+    invalid ids (>= V keys) yield zero rows regardless of weight. Same
+    block walk as `tiled_gather_sorted` (one shared builder); the weight
+    multiply rides the one-hot, not a second pass over [N, w]."""
+    if sid.shape[0] == 0:
+        return jnp.zeros((0, table.shape[1]), jnp.float32)
+    return _gather_call(table, sid, w_sorted, chunk, tile, interpret)
+
+
+def _fused_lookup_impl(params, ids, weights, interpret, presorted=None):
+    b, k = ids.shape
+    sid, perm, inv = _sort_with_inv(ids.reshape(-1), params.shape[0],
+                                    presorted)
+    w_sorted = jnp.take(weights.reshape(-1).astype(jnp.float32), perm,
+                        axis=0)
+    rows = tiled_gather_sorted_weighted(params, sid, w_sorted,
+                                        interpret=interpret)
+    # scatter-free unpermute (second-sort take, see tiled_gather), then
+    # the combine degenerates to a plain hotness-axis sum — the weights
+    # already rode the gather
+    rows = jnp.take(rows, inv, axis=0).reshape(b, k, -1)
+    return jnp.sum(rows, axis=1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused_lookup(params, ids, weights, presorted, interpret):
+    return _fused_lookup_impl(params, ids, weights, interpret,
+                              presorted=presorted)
+
+
+def _fused_lookup_fwd(params, ids, weights, presorted, interpret):
+    # one sort serves forward gather, backward aggregation and the
+    # dweights gather — identical structure to _tiled_lookup_fwd
+    sid, perm, inv = _sort_with_inv(ids.reshape(-1), params.shape[0],
+                                    presorted)
+    return (_fused_lookup_impl(params, ids, weights, interpret,
+                               presorted=(sid, perm, inv)),
+            (params, ids, weights, sid, perm, inv))
+
+
+# the backward is IDENTICAL to the tiled lookup's (same residual tuple):
+# dense-table cotangent via the sgd kernel at lr = -1, scatter-free
+_fused_lookup.defvjp(_fused_lookup_fwd, _tiled_lookup_bwd)
+
+
+def fused_lookup_combine(params: jax.Array, ids: jax.Array,
+                         weights: Optional[jax.Array] = None,
+                         combiner: str = "sum",
+                         interpret: Optional[bool] = None,
+                         presorted=None) -> jax.Array:
+    """Fused gather->combine forward (ISSUE 12): [V,W] table, [B,K] ids
+    -> [B,W] in ONE weighted-gather kernel pass + a scatter-free
+    unpermute + a plain hotness sum. Same contract as
+    `tiled_embedding_lookup` (weights carry 0.0 in padded slots; mean
+    pre-normalizes; positive OOB ids clamp like the XLA gather;
+    differentiable in params and weights, scatter-free on the dense
+    path). `presorted`: the canonical (sid, perm, inv) of the flattened
+    id stream — the tapped forward's residual sort folds the fused
+    forward's own sort away. Dispatch: DET_LOOKUP_PATH=fused in
+    `dist_model_parallel._group_lookup`."""
+    ids, weights, presorted = _combine_prologue(params, ids, weights,
+                                                combiner, presorted)
+    return _fused_lookup(params, ids, weights, presorted,
+                         interpret).astype(params.dtype)
+
+
 def tiled_embedding_lookup(params: jax.Array, ids: jax.Array,
                            weights: Optional[jax.Array] = None,
                            combiner: str = "sum",
@@ -627,16 +886,7 @@ def tiled_embedding_lookup(params: jax.Array, ids: jax.Array,
     OOB ids keep their XLA clamp semantics; NEGATIVE ids (already
     unspecified in the fused-bucket forward) read row V-1 instead of row 0
     on this path."""
-    if combiner not in ("sum", "mean"):
-        raise ValueError(f"Unsupported combiner {combiner}")
-    if weights is None:
-        weights = jnp.ones(ids.shape, jnp.float32)
-    if combiner == "mean":
-        denom = jnp.maximum(jnp.sum(weights, axis=1, keepdims=True), 1.0)
-        weights = weights / denom
-    ids = jnp.clip(ids, 0, params.shape[0] - 1)
-    if presorted is not None:
-        sid, perm, inv = presorted
-        presorted = (jnp.minimum(sid, params.shape[0] - 1), perm, inv)
+    ids, weights, presorted = _combine_prologue(params, ids, weights,
+                                                combiner, presorted)
     return _tiled_lookup(params, ids, weights, presorted,
                          interpret).astype(params.dtype)

@@ -46,6 +46,38 @@ DENSE_ELEMS_MAX = int(os.environ.get("DET_SPARSE_DENSE_MAX",
                                      16 * 1024 * 1024))
 
 
+def fp_round(x: jax.Array, zero: jax.Array) -> jax.Array:
+    """Pin a PRODUCT to its f32-rounded value before it feeds another
+    add/sub: add `zero`, a RUNTIME 0.0 the compiler cannot prove
+    constant (an SMEM hyperparameter slot inside the Pallas kernels, a
+    traced-scalar derivation here — see `round_pin`). Backend FMA
+    contraction happens BELOW HLO (LLVM fuses fmul+fadd inside one
+    fusion; `lax.optimization_barrier`, bitcast round-trips and
+    mul-by-dynamic-one pins do not reliably survive — all measured), and
+    it is context-dependent: the same expression contracts differently
+    inside a Pallas kernel body than next to a scatter whose operand
+    forces materialization. The add-zero pin is IDEMPOTENT under
+    contraction — fused or not, ``fma(a, b, 0) == round(a*b)`` — so the
+    value is the plain IEEE product either way, and the fused pallas
+    kernels and the XLA sort path round at identical seams. The
+    bit-exactness contract between the two strategies (ISSUE 12,
+    tests/test_pallas_fused.py) rests on this. (Sole side effect:
+    ``-0.0`` pins to ``+0.0`` — invisible to ``==``.)"""
+    return x + zero
+
+
+def round_pin(traced_int: jax.Array) -> jax.Array:
+    """An opaque f32 0.0 derived from a traced INTEGER scalar/array (int
+    -> float cast can never be NaN/inf, so the 0-mul identity is exact):
+    ``x*0`` only folds under nnan/ninf fast-math, which XLA:CPU/TPU do
+    not enable for f32. Pass a TRACED value (adam's step count, an id
+    array lane) — a concrete closure constant would fold at trace
+    time (eager flows need no pin: per-op dispatch rounds every
+    product)."""
+    return (traced_int.reshape(-1)[0].astype(jnp.float32)
+            * jnp.float32(0.0))
+
+
 _MEASURED_DEFAULTS: Optional[dict] = None
 
 
@@ -230,10 +262,134 @@ def _validate_pallas_scatter() -> bool:
             and bool(jnp.max(jnp.abs(t2 - t_want)) < 1e-5))
 
 
+def _width_class(width: int) -> int:
+    """Pow2 lane-width shape-class for the fused-kernel compile probes:
+    the compiled form of a BlockSpec kernel depends on the lane padding
+    of its width, not the exact value, so one compiled verdict covers
+    every width of a class (clamped to [8, 512] — wider tables share the
+    512 class's tiling)."""
+    c = 8
+    while c < width and c < 512:
+        c *= 2
+    return c
+
+
+class _ShapedKernelGate:
+    """_KernelGate twin for the fused pallas family (ISSUE 12) with one
+    verdict per (backend, width shape-class): the eager compile-probe
+    runs once per class per process; dispatch under a jit trace consults
+    only the cached verdicts. Gate failure is LOUD — every probe
+    failure, numerics mismatch, or unvalidated-trace request warns and
+    names the fallback — never silent."""
+
+    def __init__(self, validator, what: str):
+        self.validator = validator      # (width_class) -> bool, may raise
+        self.what = what
+        self.verdicts: dict = {}        # width class -> bool
+        self._trace_warned: set = set()
+
+    def prevalidate(self, width: int = 16) -> bool:
+        cls = _width_class(width)
+        if cls in self.verdicts:
+            return self.verdicts[cls]
+        import warnings
+        try:
+            ok = bool(self.validator(cls))
+            if not ok:
+                warnings.warn(
+                    f"{self.what}: compiled kernels disagree with the XLA "
+                    f"formulation at width class {cls} on this backend; "
+                    "falling back to the tiled/XLA paths", RuntimeWarning)
+        except Exception as e:  # noqa: BLE001 - toolchain may reject kernels
+            warnings.warn(
+                f"{self.what}: kernels failed to compile/run at width "
+                f"class {cls} on this backend ({str(e)[:200]}); falling "
+                "back to the tiled/XLA paths", RuntimeWarning)
+            ok = False
+        self.verdicts[cls] = ok
+        return ok
+
+    def ok(self, ref_array) -> bool:
+        """Verdict for dispatch keyed on `ref_array`'s width. Off-TPU the
+        kernels run in interpret mode — always ok (tier-1 exercises them
+        bit-exactly on CPU). Under a jit trace only cached verdicts count
+        (the eager probe fetches compiled results — illegal while
+        tracing)."""
+        if jax.default_backend() != "tpu":
+            return True
+        cls = _width_class(ref_array.shape[-1])
+        if isinstance(ref_array, jax.core.Tracer):
+            if cls not in self.verdicts and cls not in self._trace_warned:
+                self._trace_warned.add(cls)
+                import warnings
+                warnings.warn(
+                    f"{self.what} requested, but width class {cls} was "
+                    "never validated on this backend before the jit trace "
+                    "— falling back to the tiled/XLA paths. Call "
+                    "distributed_embeddings_tpu.ops.sparse_update."
+                    "prevalidate_active_impl() (or make_sparse_train_step "
+                    "/ DistributedEmbedding construction) before tracing.",
+                    RuntimeWarning, stacklevel=4)
+            return bool(self.verdicts.get(cls))
+        return self.prevalidate(ref_array.shape[-1])
+
+
+def _validate_pallas_fused(width: int) -> bool:
+    """Compiled correctness of the fused deduped-row kernels (ISSUE 12,
+    ops/pallas_tiled.py *_rows + the weighted gather) at one lane-width
+    class, against the XLA sort-path formulations they must reproduce."""
+    import numpy as np
+    from distributed_embeddings_tpu.ops import pallas_tiled as ptl
+    rng = np.random.RandomState(0)
+    v, n, w = 4096, 1024, width
+    ids = jnp.asarray(rng.randint(0, v, n).astype(np.int32))
+    delta = jnp.asarray(rng.randn(n, w).astype(np.float32))
+    table = jnp.asarray(rng.randn(v, w).astype(np.float32))
+    rep, sums = dedup_sum(ids, delta, sentinel=v)
+    fl = dedup_flags()
+    got = ptl.tiled_sgd_rows(table, rep, sums, 0.05, interpret=False)
+    want = table.at[rep].add(-0.05 * sums, mode="drop", **fl)
+    ok = bool(jnp.max(jnp.abs(got - want)) < 1e-4)
+    acc = jnp.full((v, w), 0.1, jnp.float32)
+    t2, a2 = ptl.tiled_adagrad_rows(table, acc, rep, sums, 0.05,
+                                    interpret=False)
+    a_want = acc.at[rep].add(sums * sums, mode="drop", **fl)
+    d_want = -0.05 * sums * lax.rsqrt(
+        jnp.take(a_want, jnp.minimum(rep, v - 1), axis=0) + 1e-10)
+    t_want = table.at[rep].add(d_want, mode="drop", **fl)
+    ok = (ok and bool(jnp.max(jnp.abs(a2 - a_want)) < 1e-4)
+          and bool(jnp.max(jnp.abs(t2 - t_want)) < 1e-4))
+    mu = jnp.zeros((v, w), jnp.float32)
+    nu = jnp.zeros((v, w), jnp.float32)
+    cnt = jnp.zeros((), jnp.int32)
+    t4, mu4, nu4, _ = ptl.tiled_adam_rows(table, mu, nu, cnt, rep, sums,
+                                          0.01, interpret=False)
+    tw, muw, nuw, _ = sparse_adam(table, mu, nu, cnt,
+                                  SparseRowGrad(ids, delta), 0.01,
+                                  strategy="sort")
+    ok = (ok and bool(jnp.max(jnp.abs(t4 - tw)) < 1e-4)
+          and bool(jnp.max(jnp.abs(mu4 - muw)) < 1e-4)
+          and bool(jnp.max(jnp.abs(nu4 - nuw)) < 1e-4))
+    # fused forward: weighted gather->combine vs the XLA gather+einsum
+    ids2 = ids[:(n // 4) * 4].reshape(-1, 4)
+    wts = jnp.asarray(np.abs(rng.rand(*ids2.shape)).astype(np.float32))
+    got_f = ptl.fused_lookup_combine(table, ids2, wts, "sum",
+                                     interpret=False)
+    want_f = jnp.einsum("bk,bkw->bw", wts, jnp.take(table, ids2, axis=0))
+    return ok and bool(jnp.max(jnp.abs(got_f - want_f)) < 1e-3)
+
+
 _TILED_GATE = _KernelGate("tiled", _validate_tiled,
                           "DET_SCATTER_IMPL=tiled")
-_PALLAS_GATE = _KernelGate("pallas", _validate_pallas_scatter,
-                           "DET_SCATTER_IMPL=pallas")
+# the round-3 per-row DMA RMW kernels (ops/pallas_scatter.py) moved to
+# DET_SCATTER_IMPL=pallas-dma in round 12: 'pallas' now names the fused
+# deduped-row tile-walk strategy below. The DMA family keeps its gate —
+# the r03 toolchain rejected every make_async_copy kernel, so its
+# failure path stays load-bearing.
+_PALLAS_GATE = _KernelGate("pallas-dma", _validate_pallas_scatter,
+                           "DET_SCATTER_IMPL=pallas-dma")
+_PALLAS_FUSED_GATE = _ShapedKernelGate(_validate_pallas_fused,
+                                       "DET_SCATTER_IMPL=pallas")
 
 
 def prevalidate_tiled() -> bool:
@@ -285,19 +441,151 @@ def prevalidate_pallas_scatter() -> bool:
     return _PALLAS_GATE.prevalidate()
 
 
-def prevalidate_active_impl(strategy: Optional[str] = None) -> None:
+def prevalidate_pallas_fused(width: int = 16) -> bool:
+    """Eager compile-probe of the fused pallas family at `width`'s
+    shape-class (see _ShapedKernelGate)."""
+    return _PALLAS_FUSED_GATE.prevalidate(width)
+
+
+def pallas_kernels_ok(ref_array) -> bool:
+    """Validation verdict for the fused pallas kernel family, keyed on
+    `ref_array`'s width class. Off-TPU the kernels run in interpret mode
+    — always ok; under a jit trace only cached verdicts count."""
+    return _PALLAS_FUSED_GATE.ok(ref_array)
+
+
+def pallas_fwd_ok_static(width: int) -> bool:
+    """Trace-time twin of `pallas_kernels_ok` (the tiled_fwd_ok_static
+    analogue): off-TPU always ok (interpret); on TPU only an
+    already-cached verdict for `width`'s class counts."""
+    if jax.default_backend() != "tpu":
+        return True
+    return bool(_PALLAS_FUSED_GATE.verdicts.get(_width_class(width)))
+
+
+def _pallas_requested(strategy: str) -> bool:
+    """Did this call opt into the fused pallas strategy: explicit
+    strategy='pallas', or auto + DET_SCATTER_IMPL=pallas (TPU only —
+    the env route never flips CPU test numerics)."""
+    if strategy == "pallas":
+        return True
+    return (strategy == "auto"
+            and measured_default("DET_SCATTER_IMPL", "xla") == "pallas"
+            and jax.default_backend() == "tpu")
+
+
+_PALLAS_FALLBACK_WARNED: set = set()
+
+
+def _route_static(strategy: str, width: Optional[int]) -> str:
+    """The ONE fallback lattice — 'pallas' | 'tiled' | 'xla' from the
+    request knobs, the cumsum refusal and the CACHED gate verdicts (no
+    probing, no warnings). Shared by dispatch (`_scatter_route`), the
+    obs label (`active_scatter_impl`) and the fold planner
+    (`update_consumes_sort`) so the three can never drift. ``width``
+    keys the pallas verdict's shape class; None means "any validated
+    class" — the process-level telemetry view."""
+    if _pallas_requested(strategy):
+        if _dedup_impl() != "cumsum":
+            if jax.default_backend() != "tpu":
+                return "pallas"         # interpret-mode kernels
+            vs = _PALLAS_FUSED_GATE.verdicts
+            if (any(vs.values()) if width is None
+                    else bool(vs.get(_width_class(width)))):
+                return "pallas"
+        # requested but unavailable (gate / cumsum): the loud fallback
+        if jax.default_backend() == "tpu" and bool(_TILED_GATE.verdict):
+            return "tiled"
+        return "xla"
+    if strategy == "tiled":
+        return ("tiled" if jax.default_backend() != "tpu"
+                or bool(_TILED_GATE.verdict) else "xla")
+    if (strategy == "auto"
+            and measured_default("DET_SCATTER_IMPL", "xla") == "tiled"
+            and jax.default_backend() == "tpu"):
+        return "tiled" if bool(_TILED_GATE.verdict) else "xla"
+    return "xla"
+
+
+def _scatter_route(strategy: str, ref_array) -> str:
+    """Which update family serves this call: `_route_static`'s lattice,
+    plus the EAGER per-shape-class compile probe (`pallas_kernels_ok`
+    may prevalidate outside a trace) and the loud-fallback warning. A
+    requested-but-unavailable pallas path falls back to the
+    hardware-validated tiled family, else to the XLA path — never
+    silently. The cumsum dedup impl also falls back: its rep stream is
+    unique but UNSORTED, which the tile walk's chunk layout cannot
+    consume."""
+    if (_pallas_requested(strategy) and _dedup_impl() != "cumsum"
+            and pallas_kernels_ok(ref_array)):
+        return "pallas"
+    if not _pallas_requested(strategy):
+        # the tiled routes keep their own eager probe (_KernelGate)
+        return "tiled" if _tiled_route(strategy, ref_array) else "xla"
+    # pallas requested but unavailable: resolve the fallback, loudly
+    route = _route_static(strategy, ref_array.shape[-1])
+    reason = ("cumsum-dedup" if _dedup_impl() == "cumsum"
+              else "gate-failed")
+    if reason not in _PALLAS_FALLBACK_WARNED:
+        _PALLAS_FALLBACK_WARNED.add(reason)
+        import warnings
+        warnings.warn(
+            f"DET_SCATTER_IMPL=pallas requested but unavailable "
+            f"({reason}); this update dispatches to the {route} "
+            "path instead", RuntimeWarning, stacklevel=3)
+    return route
+
+
+def gate_verdicts() -> dict:
+    """{impl: verdict} for the ``kernels/gate_verdict{impl=}`` obs gauge:
+    1 = hardware-validated, 0 = probe failed, -1 = never probed (off-TPU
+    interpret mode, or the impl was never requested). The fused pallas
+    gate aggregates its per-shape-class verdicts: 1 only when every
+    probed class validated — so a run can legitimately show a
+    pallas-labeled update span (SOME class dispatched) next to a 0 gauge
+    (not ALL classes validated); the mixed-verdict case is visible, not
+    averaged away."""
+    def enc(v):
+        return -1 if v is None else int(bool(v))
+    vs = _PALLAS_FUSED_GATE.verdicts
+    return {"tiled": enc(_TILED_GATE.verdict),
+            "pallas-dma": enc(_PALLAS_GATE.verdict),
+            "pallas": (-1 if not vs else int(all(vs.values())))}
+
+
+def active_scatter_impl(strategy: str = "auto") -> str:
+    """Static best answer to "which update family will a step traced now
+    dispatch to" — the obs label for the per-strategy update-phase span
+    and bench arm records. `_route_static` at the process level (no
+    width, no eager probes)."""
+    return _route_static(strategy, None)
+
+
+def prevalidate_active_impl(strategy: Optional[str] = None,
+                            widths=None) -> None:
     """Eagerly validate whichever kernel impl the env knobs (or an explicit
     strategy= argument) select so subsequently-traced train steps can
     dispatch to it. Call once before jitting a train step; no-op for the
-    XLA default. Wired into make_sparse_train_step, so user code need not
-    call it."""
+    XLA default. Wired into make_sparse_train_step and
+    DistributedEmbedding construction, so user code need not call it.
+
+    `widths`: the table lane widths the caller will actually dispatch at
+    (the layer/step factories pass their plan's bucket+row widths) — the
+    fused pallas gate probes one compiled verdict per width SHAPE-CLASS,
+    and a class never probed eagerly can never validate under the jit
+    trace. None falls back to the two bench lane classes (16, 128)."""
     impl = measured_default("DET_SCATTER_IMPL", "xla")
     if jax.default_backend() != "tpu":
         return
     if (impl == "tiled" or strategy == "tiled"
             or measured_default("DET_LOOKUP_PATH", "auto") == "tiled"):
         _TILED_GATE.prevalidate()
-    if impl == "pallas":
+    if (impl == "pallas" or strategy == "pallas"
+            or measured_default("DET_LOOKUP_PATH", "auto") == "fused"):
+        for w in sorted({_width_class(w)
+                         for w in (widths or (16, 128))}):
+            _PALLAS_FUSED_GATE.prevalidate(w)
+    if impl == "pallas-dma":
         _PALLAS_GATE.prevalidate()
 
 
@@ -318,9 +606,9 @@ def _use_pallas_scatter(ref_array) -> bool:
 def _row_scatter_add(table: jax.Array, rep: jax.Array,
                      delta: jax.Array) -> jax.Array:
     """table[rep] += delta for dedup output (unique rep; OOB fillers carry
-    zero delta). Routes to the Pallas RMW kernel under
-    DET_SCATTER_IMPL=pallas when hardware-validated (prevalidate above);
-    default is the flagged XLA scatter."""
+    zero delta). Routes to the per-row DMA RMW kernel under
+    DET_SCATTER_IMPL=pallas-dma when hardware-validated (prevalidate
+    above); default is the flagged XLA scatter."""
     if _use_pallas_scatter(table):
         from distributed_embeddings_tpu.ops import pallas_scatter as ps
         return ps.scatter_add_sorted_unique(
@@ -525,20 +813,36 @@ def _usable_presorted(presorted, grad: SparseRowGrad, rows: int):
 # ------------------------------------------------------------------ SGD
 def sparse_sgd(table: jax.Array, grad: SparseRowGrad, lr,
                strategy: str = "auto", presorted=None) -> jax.Array:
-    """table[ids] -= lr * contribs. Duplicates need no aggregation (add is
-    associative); OOB/padded ids are dropped by the scatter. (The round-3
-    DET_SGD_DEDUP aggregate-first variant was removed in round 5: the
-    tiled kernel family subsumes its hypothesis — aggregation happens
-    in-kernel with no scatter at all — and the knob never earned a
-    hardware number; docs/round5_notes.md 'knob disposition'.)
-    `presorted` (GroupSort) feeds the tiled kernel's sorted stream; the
-    XLA scatter path needs no order and ignores it."""
-    if _tiled_route(strategy, table):
+    """table[ids] -= lr * contribs. Under 'auto'/'dense', duplicates need
+    no aggregation (add is associative) and the plain duplicate-safe
+    scatter runs; OOB/padded ids are dropped. The EXPLICIT 'sort'
+    strategy — and the fused 'pallas' strategy built on its aggregation
+    — dedups first (one segment-sum total per row, the reference's
+    unique-grad contract): the sort aggregation IS the strategy, it
+    consumes the folded forward sort, and it is the seam that makes the
+    fused pallas step bit-exact against the XLA sort path (ISSUE 12 —
+    duplicate-heavy streams see last-ulp differences vs the sequential
+    scatter, within every documented tolerance). (The round-3
+    DET_SGD_DEDUP knob this resembles was removed in round 5 without a
+    hardware number; the tiled kernel family and this seam subsume its
+    hypothesis.) `presorted` (GroupSort) feeds the tiled/pallas sorted
+    stream and the sort-strategy dedup; 'auto''s scatter ignores it."""
+    rows = table.shape[0]
+    ps = _usable_presorted(presorted, grad, rows)
+    route = _scatter_route(strategy, table)
+    if route == "tiled":
         from distributed_embeddings_tpu.ops import pallas_tiled as ptl
-        ps = _usable_presorted(presorted, grad, table.shape[0])
         return ptl.tiled_sgd(table, grad.ids, grad.contribs, lr,
                              presorted=(None if ps is None
                                         else (ps.sid, ps.perm)))
+    if route == "pallas" or strategy == "sort":
+        rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows,
+                              presorted=ps)
+        if route == "pallas":
+            from distributed_embeddings_tpu.ops import pallas_tiled as ptl
+            return ptl.tiled_sgd_rows(table, rep, sums, lr)
+        return table.at[rep].add((-lr * sums).astype(table.dtype),
+                                 mode="drop", **dedup_flags())
     # negative ids -> dropped OOB row, not NumPy wraparound (see dedup_sum)
     safe_ids = jnp.where(grad.ids < 0, table.shape[0], grad.ids)
     return table.at[safe_ids].add(
@@ -560,7 +864,8 @@ def sparse_adagrad(table: jax.Array, accum: jax.Array, grad: SparseRowGrad,
     """
     rows = table.shape[0]
     ps = _usable_presorted(presorted, grad, rows)
-    if _tiled_route(strategy, table):
+    route = _scatter_route(strategy, table)
+    if route == "tiled":
         # tiled one-hot-matmul kernel: sort + in-kernel aggregation, no
         # dedup pass, no scatter (see ops/pallas_tiled.py). Explicit
         # strategy="tiled" runs in interpret mode off-TPU (tests).
@@ -569,6 +874,18 @@ def sparse_adagrad(table: jax.Array, accum: jax.Array, grad: SparseRowGrad,
                                  lr, eps=eps,
                                  presorted=(None if ps is None
                                             else (ps.sid, ps.perm)))
+    if route == "pallas":
+        # fused sparse path (ISSUE 12): the EXACT dedup aggregation
+        # (shared bit-for-bit with the sort path below, consuming the
+        # folded forward sort) feeds one tile-walk RMW stream that reads
+        # and writes each touched table+accumulator tile once — vs the
+        # sort path's 2 scatters + 1 gather over the same rows.
+        # Bit-exact vs the sort path (tests/test_pallas_fused.py).
+        from distributed_embeddings_tpu.ops import pallas_tiled as ptl
+        rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows,
+                              presorted=ps)
+        return ptl.tiled_adagrad_rows(table, accum, rep, sums, lr,
+                                      eps=eps)
     how = _pick(strategy, rows, table.shape[-1])
     if how == "dense":
         g, counts = _dense_sum(grad.ids, grad.contribs, rows)
@@ -613,12 +930,23 @@ def sparse_adam(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
     """
     rows = table.shape[0]
     ps = _usable_presorted(presorted, grad, rows)
-    if _tiled_route(strategy, table):
+    route = _scatter_route(strategy, table)
+    if route == "tiled":
         from distributed_embeddings_tpu.ops import pallas_tiled as ptl
         return ptl.tiled_adam(table, mu, nu, count, grad.ids, grad.contribs,
                               lr, b1=b1, b2=b2, eps=eps,
                               presorted=(None if ps is None
                                          else (ps.sid, ps.perm)))
+    if route == "pallas":
+        # fused sparse path: exact dedup + one RMW stream over
+        # table/mu/nu tiles (see sparse_adagrad); the kernel's count
+        # column rebuilds the touched mask, so lazy moment decay is
+        # bit-identical to the sort path's .at[rep].set
+        from distributed_embeddings_tpu.ops import pallas_tiled as ptl
+        rep, sums = dedup_sum(grad.ids, grad.contribs, sentinel=rows,
+                              presorted=ps)
+        return ptl.tiled_adam_rows(table, mu, nu, count, rep, sums, lr,
+                                   b1=b1, b2=b2, eps=eps)
     how = _pick(strategy, rows, table.shape[-1])
     if how == "dense":
         g, counts = _dense_sum(grad.ids, grad.contribs, rows)
@@ -636,10 +964,19 @@ def sparse_adam(table: jax.Array, mu: jax.Array, nu: jax.Array, count,
     fl = dedup_flags()
     srt = fl["indices_are_sorted"]
     safe = jnp.minimum(rep, rows - 1)
-    mu_rows = (b1 * jnp.take(mu, safe, axis=0, indices_are_sorted=srt)
-               + (1 - b1) * sums)
-    nu_rows = (b2 * jnp.take(nu, safe, axis=0, indices_are_sorted=srt)
-               + (1 - b2) * sums * sums)
+    # fp_round pins each moment product's rounding (no context-dependent
+    # FMA fusion) — the identical pins live in the fused pallas kernels,
+    # so the two strategies stay bit-exact (see fp_round). `count` is
+    # traced in every jitted flow, making the pin opaque to the backend.
+    # The square is parenthesized FIRST so neither side leaves the
+    # association to a simplifier.
+    zero = round_pin(count)
+    mu_rows = (fp_round(b1 * jnp.take(mu, safe, axis=0,
+                                      indices_are_sorted=srt), zero)
+               + fp_round((1 - b1) * sums, zero))
+    nu_rows = (fp_round(b2 * jnp.take(nu, safe, axis=0,
+                                      indices_are_sorted=srt), zero)
+               + fp_round((1 - b2) * fp_round(sums * sums, zero), zero))
     mu_new = mu.at[rep].set(mu_rows, mode="drop", **fl)
     nu_new = nu.at[rep].set(nu_rows, mode="drop", **fl)
     delta = -lr * (mu_rows / c1) / (jnp.sqrt(nu_rows / c2) + eps)
@@ -845,20 +1182,23 @@ def update_consumes_sort(kind: str, strategy: str, rows: int,
     sparse_sgd/adagrad/adam exactly, so forwards can decide at trace time
     whether producing the artifact is worthwhile (an unconsumed sort is
     not free: DCE does not reach through shard_map boundaries)."""
-    if jax.default_backend() == "tpu":
-        tiled = ((strategy == "tiled" and bool(_TILED_GATE.verdict))
-                 or (strategy == "auto"
-                     and measured_default("DET_SCATTER_IMPL", "xla")
-                     == "tiled" and bool(_TILED_GATE.verdict)))
-    else:
-        # off-TPU: explicit 'tiled' runs interpret-mode kernels; the
-        # auto+env route is TPU-only (_KernelGate.active)
-        tiled = strategy == "tiled"
-    if tiled:
-        return True                      # all three kinds take (sid, perm)
-    if _pick(strategy, rows, width) != "sort":
+    # one lattice with the actual dispatch (`_route_static`): both kernel
+    # families consume the sorted stream, and a pallas/tiled request that
+    # fell back onto the XLA path lands in its dedup branch (how is not
+    # 'dense') — which consumes the artifact for adagrad/adam. Returning
+    # False for a degraded route would strip the fallback of its sort
+    # fold (review finding).
+    route = _route_static(strategy, width)
+    if route in ("pallas", "tiled"):
+        return True                      # tile walks take (sid, perm)
+    if _pick(strategy, rows, width) == "dense":
         return False                     # dense path aggregates scatterwise
-    return kind in ("adagrad", "adam")   # sgd's plain scatter needs no order
+    if kind == "sgd":
+        # only the EXPLICIT sort strategy dedups for sgd (aggregate-first
+        # seam, see sparse_sgd); auto's plain scatter needs no order, and
+        # the degraded pallas/tiled->xla routes keep sgd's plain scatter
+        return strategy == "sort"
+    return kind in ("adagrad", "adam")
 
 
 def make_sparse_optimizer(kind: str, lr, strategy: str = "auto",

@@ -151,7 +151,8 @@ class LookaheadEngine:
         # shared with the monolithic step — the bit-exactness contract
         # between the two step forms depends on it
         scheduled, sopt_for, dense_optimizer = _sparse_optimizer_setup(
-            optimizer, lr, strategy, dense_optimizer)
+            optimizer, lr, strategy, dense_optimizer,
+            widths=emb.plan_widths())
         # lookahead=0 path AND the shared init_fn: the monolithic step
         # itself — delegation is what makes depth 0 bit-identical
         self._init_fn, self._base_step = make_sparse_train_step(

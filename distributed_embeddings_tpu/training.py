@@ -182,7 +182,7 @@ def _merge_dense(dense, params):
 
 
 def _sparse_optimizer_setup(optimizer: str, lr, strategy: str,
-                            dense_optimizer):
+                            dense_optimizer, widths=None):
     """Sparse + dense optimizer construction shared by the monolithic
     step (`make_sparse_train_step`) and the lookahead engine
     (`schedule.LookaheadEngine`) — ONE home for the eps parity
@@ -205,7 +205,7 @@ def _sparse_optimizer_setup(optimizer: str, lr, strategy: str,
     # eagerly validate any DET_SCATTER_IMPL kernel choice on the attached
     # chip now — inside the traced step only the cached verdict is
     # consulted, so without this call the env knob would be silently inert
-    prevalidate_active_impl(strategy=strategy)
+    prevalidate_active_impl(strategy=strategy, widths=widths)
     sopt = make_sparse_optimizer(optimizer, 0.0 if scheduled else lr,
                                  strategy=strategy, **sparse_hp)
     if dense_optimizer is None:
@@ -269,7 +269,8 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
     """
     emb = model.embedding
     scheduled, sopt_for, dense_optimizer = _sparse_optimizer_setup(
-        optimizer, lr, strategy, dense_optimizer)
+        optimizer, lr, strategy, dense_optimizer,
+        widths=emb.plan_widths())
     sopt = sopt_for()
 
     def init_fn(params):
@@ -654,6 +655,19 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
 
     next_batch = None
     examples_total = 0
+    # per-strategy update-phase attribution (ISSUE 12): the step span
+    # gains a nested span whose PATH names the sparse-update kernel
+    # family the traced step dispatches to (xla/tiled/pallas — resolved
+    # once, from the env knobs + cached gate verdicts), so snapshots and
+    # the soak harness can see WHICH path actually ran. Like train/step
+    # itself this times the host-side dispatch; the count/label is the
+    # signal, not the duration.
+    if sparse:
+        from distributed_embeddings_tpu.ops.sparse_update import (
+            active_scatter_impl)
+        update_impl = active_scatter_impl()
+    else:
+        update_impl = "dense"
     import time as _time
     t_run0 = _time.perf_counter()
     try:
@@ -700,7 +714,8 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
             # work the engine does); device time hides behind async
             # dispatch except at sync boundaries — the honest host-side
             # reading, same clock the reference's fit loop shows
-            with span("train/step", reg):
+            with span("train/step", reg), \
+                    span(f"update/{update_impl}", reg):
                 if la_engine is not None:
                     params, opt_state, loss = la_engine.step(
                         params, opt_state, batch, next_batch)
@@ -774,6 +789,14 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
     # vocab cycle), the embedded snapshot, and the JSONL export hook
     elapsed = max(_time.perf_counter() - t_run0, 1e-9)
     reg.gauge("train/examples_per_sec").set(examples_total / elapsed)
+    try:
+        # kernel dispatch telemetry (ISSUE 12): gate verdicts per impl so
+        # the SLO rule file can require the verdict's presence
+        from distributed_embeddings_tpu.obs.instrument import (
+            export_kernel_gauges)
+        export_kernel_gauges(reg)
+    except Exception as e:  # noqa: BLE001 - accounting never kills a run
+        history["metrics_error"] = str(e)[:200]
     emb = getattr(model, "embedding", None)
     if emb is not None and hasattr(emb, "exchange_padding_report"):
         try:

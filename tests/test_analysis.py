@@ -395,6 +395,7 @@ def test_audit_driver_matrix_green_and_mutations_flag():
     assert failures == [], failures
     assert {r["program"] for r in records} == {
         "monolithic_f32", "monolithic_bf16", "vocab_slack_step",
+        "monolithic_tiled", "pallas_strategy_step",
         "lookahead_prefetch", "lookahead_fused", "serve_forward"}
     mrecords, mfailures = ha.run_mutations()
     assert mfailures == [], mfailures

@@ -374,7 +374,10 @@ def test_random_sparse_train_equivalence(seed):
         width = int(rng.choice([4, 8, 16]))
         combiner = ["sum", "mean"][rng.randint(2)]
         specs.append((vocab, width, combiner))
-    dedup = ["sort", "dense", "auto"][rng.randint(3)]
+    # scatter_impl axis (ISSUE 12): the fused pallas strategy rides the
+    # sweep next to the XLA aggregation strategies — every random corner
+    # that holds for 'sort' must hold for the deduped-row tile walk too
+    dedup = ["sort", "dense", "auto", "pallas"][rng.randint(4)]
     placement = ["memory_balanced", "comm_balanced", "basic"][rng.randint(3)]
     offload = rng.rand() < 0.5
     try:

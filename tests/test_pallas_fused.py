@@ -1,0 +1,420 @@
+"""Fused Pallas sparse path (ISSUE 12): DET_SCATTER_IMPL=pallas.
+
+The contract under test: the fused strategy — exact `dedup_sum`
+aggregation feeding one tile-walk RMW kernel per bucket
+(ops/pallas_tiled.tiled_*_rows), plus the fused gather->combine forward
+(fused_lookup_combine) — runs the full sparse train step BIT-exactly
+against the XLA sort strategy (f32, interpret mode on CPU) across
+sgd/adagrad/adam x padded/ragged exchange x hot-rows on/off, composes
+with lookahead=1, and falls back LOUDLY (never silently) when its gate
+fails. Bit-exactness rests on the shared dedup aggregation, exact
+one-hot placement of unique rows, and the fp_round rounding pins (see
+ops/sparse_update.fp_round).
+"""
+
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_embeddings_tpu.ops import pallas_tiled as pt
+from distributed_embeddings_tpu.ops import sparse_update as su
+from distributed_embeddings_tpu.parallel.mesh import create_mesh
+from distributed_embeddings_tpu.training import make_sparse_train_step
+
+from test_sparse_train import TinyModel, BATCH
+
+SPECS = [(96, 8, "sum"), (50, 8, "mean"), (70, 8, "sum")]
+
+
+def _grad_case(seed, v=200, w=8, n=513):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(-5, v + 8, n).astype(np.int32)  # dupes + OOB both ways
+    contribs = rng.randn(n, w).astype(np.float32)
+    table = rng.randn(v, w).astype(np.float32)
+    return (su.SparseRowGrad(jnp.asarray(ids), jnp.asarray(contribs)),
+            jnp.asarray(table), v, w)
+
+
+# ------------------------------------------------- kernel-level parity
+@pytest.mark.parametrize("optimizer", ["sgd", "adagrad", "adam"])
+def test_pallas_strategy_update_bitexact_vs_sort(optimizer):
+    """sparse_sgd/adagrad/adam(strategy='pallas') == strategy='sort'
+    bit-for-bit under jit (traced ids keep the rounding pins opaque),
+    over multiple accumulating steps."""
+    g, table, v, w = _grad_case(3)
+
+    def run(strategy):
+        if optimizer == "sgd":
+            f = jax.jit(lambda t, i, c: (su.sparse_sgd(
+                t, su.SparseRowGrad(i, c), 0.05, strategy=strategy),))
+            state = (table,)
+        elif optimizer == "adagrad":
+            f = jax.jit(lambda t, a, i, c: su.sparse_adagrad(
+                t, a, su.SparseRowGrad(i, c), 0.05, strategy=strategy))
+            state = (table, jnp.full((v, w), 0.1, jnp.float32))
+        else:
+            f = jax.jit(lambda t, m, u, c0, i, c: su.sparse_adam(
+                t, m, u, c0, su.SparseRowGrad(i, c), 0.01,
+                strategy=strategy))
+            state = (table, jnp.zeros((v, w)), jnp.zeros((v, w)),
+                     jnp.zeros((), jnp.int32))
+        for _ in range(3):
+            state = f(*state, g.ids, g.contribs)
+        return state
+
+    got = run("pallas")
+    want = run("sort")
+    for i, (a, b) in enumerate(zip(got, want)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"{optimizer} leaf {i}")
+
+
+def test_rows_appliers_exact_placement():
+    """The deduped-row appliers place each unique row's total EXACTLY
+    (one-hot matmul with a unique stream): sgd_rows at lr=-1 over a zero
+    table reproduces the dedup sums bit-for-bit, fillers dropped."""
+    g, table, v, w = _grad_case(5)
+    rep, sums = su.dedup_sum(g.ids, g.contribs, sentinel=v)
+    placed = pt.tiled_sgd_rows(jnp.zeros((v, w)), rep, sums, -1.0,
+                               interpret=True)
+    want = jnp.zeros((v, w)).at[rep].add(sums, mode="drop",
+                                         **su.dedup_flags())
+    np.testing.assert_array_equal(np.asarray(placed), np.asarray(want))
+
+
+def test_fused_lookup_matches_reference():
+    """fused_lookup_combine == the XLA gather+einsum formulation (sum and
+    mean, weighted and not) to f32 tolerance, with exact grads in params
+    and weights, and the presorted path bit-identical to the fresh-sort
+    path."""
+    rng = np.random.RandomState(7)
+    v, w = 120, 8
+    table = jnp.asarray(rng.randn(v, w).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, v, (24, 3)).astype(np.int32))
+    wts = jnp.asarray(rng.rand(24, 3).astype(np.float32))
+    for comb in ("sum", "mean"):
+        for weights in (wts, None):
+            got = pt.fused_lookup_combine(table, ids, weights, comb,
+                                          interpret=True)
+            wv = weights if weights is not None else jnp.ones(
+                ids.shape, jnp.float32)
+            ref = jnp.einsum("bk,bkw->bw", wv,
+                             jnp.take(table, ids, axis=0))
+            if comb == "mean":
+                ref = ref / jnp.maximum(jnp.sum(wv, axis=1,
+                                                keepdims=True), 1.0)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)
+    # grads (dense path, scatter-free by construction)
+    cot = jnp.asarray(rng.randn(24, w).astype(np.float32))
+
+    def f(t, wv):
+        return jnp.vdot(pt.fused_lookup_combine(t, ids, wv, "sum",
+                                                interpret=True), cot)
+
+    def fr(t, wv):
+        return jnp.vdot(jnp.einsum("bk,bkw->bw", wv,
+                                   jnp.take(t, ids, axis=0)), cot)
+
+    gt, gw = jax.grad(f, argnums=(0, 1))(table, wts)
+    rt, rw = jax.grad(fr, argnums=(0, 1))(table, wts)
+    np.testing.assert_allclose(np.asarray(gt), np.asarray(rt), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=1e-4,
+                               atol=1e-4)
+    # presorted == fresh sort, bit-identical
+    from distributed_embeddings_tpu.ops.embedding_ops import (
+        canonical_id_sort)
+    gs = canonical_id_sort(ids, v, want_inv=True)
+    a = pt.fused_lookup_combine(table, ids, wts, "sum", interpret=True)
+    b = pt.fused_lookup_combine(table, ids, wts, "sum", interpret=True,
+                                presorted=(gs.sid, gs.perm, gs.inv))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_lookup_invalid_ids_clamp():
+    """Positive OOB ids clamp to the last row (XLA gather parity);
+    zero-weight lanes contribute nothing even at OOB ids."""
+    table = jnp.asarray(np.arange(40, dtype=np.float32).reshape(5, 8))
+    ids = jnp.asarray([[0, 9], [2, 3]], jnp.int32)
+    wts = jnp.asarray([[1.0, 1.0], [1.0, 0.0]], jnp.float32)
+    got = np.asarray(pt.fused_lookup_combine(table, ids, wts, "sum",
+                                             interpret=True))
+    want = np.stack([np.asarray(table[0] + table[4]),
+                     np.asarray(table[2])])
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+# ------------------------------------------------- full train-step matrix
+def _run_steps(model, optimizer, strategy, weights, head, batches):
+    init_fn, step_fn = make_sparse_train_step(model, optimizer, lr=0.05,
+                                              strategy=strategy)
+    params = {"embedding": model.embedding.set_weights(weights),
+              "head": {"w": jnp.asarray(head)}}
+    state = init_fn(params)
+    losses = []
+    for cats, labels in batches:
+        params, state, loss = step_fn(params, state,
+                                      jnp.zeros((BATCH, 1)), cats, labels)
+        losses.append(float(loss))
+    return losses, model.embedding.get_weights(params["embedding"])
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adagrad", "adam"])
+@pytest.mark.parametrize("ragged", [False, True])
+def test_pallas_train_step_bitexact_matrix(optimizer, ragged, monkeypatch):
+    """The acceptance gate: DET_SCATTER_IMPL strategy 'pallas' runs the
+    full distributed sparse train step (8-device mesh, interpret-mode
+    kernels) BIT-exactly vs the 'sort' strategy, across optimizers and
+    the padded/ragged exchange axis."""
+    monkeypatch.setenv("DET_RAGGED_EXCHANGE", "1" if ragged else "0")
+    rng = np.random.RandomState(17)
+    mesh = create_mesh(jax.devices()[:8])
+    weights = [rng.randn(v, w).astype(np.float32) * 0.1
+               for v, w, _ in SPECS]
+    head = rng.randn(sum(w for _, w, _ in SPECS), 1).astype(np.float32)
+    r2 = np.random.RandomState(23)
+    batches = []
+    for _ in range(2):
+        cats = [jnp.asarray(r2.randint(0, v, size=(BATCH, 3)))
+                for v, _, _ in SPECS]
+        batches.append((cats, jnp.asarray(r2.randn(BATCH)
+                                          .astype(np.float32))))
+
+    def build():
+        return TinyModel(SPECS, mesh, input_max_hotness=[3] * len(SPECS))
+
+    l_p, w_p = _run_steps(build(), optimizer, "pallas", weights, head,
+                          batches)
+    l_s, w_s = _run_steps(build(), optimizer, "sort", weights, head,
+                          batches)
+    assert l_p == l_s, f"losses diverged: {l_p} vs {l_s}"
+    for t, (a, b) in enumerate(zip(w_s, w_p)):
+        np.testing.assert_array_equal(b, a, err_msg=f"table {t}")
+
+
+def test_pallas_train_step_bitexact_hot_rows():
+    """Hot-rows axis of the matrix: with a replicated hot shard admitted
+    mid-run (observe -> sync), the pallas and sort strategies still agree
+    bit-for-bit — the hot shard's dense psum update is strategy-
+    independent and the sentinel-masked miss stream rides the same dedup
+    seam."""
+    specs = [(60, 8, "sum"), (90, 8, "sum")]
+    rng = np.random.RandomState(31)
+    mesh = create_mesh(jax.devices()[:8])
+    weights = [rng.randn(v, w).astype(np.float32) * 0.1
+               for v, w, _ in specs]
+    head = rng.randn(16, 1).astype(np.float32)
+    data = np.random.RandomState(41)
+    batches = []
+    for _ in range(4):
+        cats = [jnp.asarray(np.minimum(
+            data.zipf(1.3, size=(BATCH, 2)) - 1, v - 1).astype(np.int32))
+            for v, _, _ in specs]
+        batches.append((cats, jnp.asarray(data.randn(BATCH)
+                                          .astype(np.float32))))
+
+    def run(strategy):
+        model = TinyModel(specs, mesh, hot_rows=8,
+                          input_max_hotness=[2, 2])
+        init_fn, step_fn = make_sparse_train_step(model, "adagrad",
+                                                  lr=0.05,
+                                                  strategy=strategy)
+        params = {"embedding": model.embedding.set_weights(weights),
+                  "head": {"w": jnp.asarray(head)}}
+        state = init_fn(params)
+        losses = []
+        for i, (cats, labels) in enumerate(batches):
+            model.embedding.observe_hot_ids(cats)
+            if i == 1:      # admit mid-run: steps 2+ exercise hot hits
+                p_emb, s_emb = model.embedding.sync_hot_rows(
+                    params["embedding"], state["emb"], admit=True)
+                params = {**params, "embedding": p_emb}
+                state = {**state, "emb": s_emb}
+            params, state, loss = step_fn(params, state,
+                                          jnp.zeros((BATCH, 1)), cats,
+                                          labels)
+            losses.append(float(loss))
+        p_sync, _ = model.embedding.sync_hot_rows(params["embedding"],
+                                                  state["emb"])
+        return losses, model.embedding.get_weights(p_sync)
+
+    l_p, w_p = run("pallas")
+    l_s, w_s = run("sort")
+    assert l_p == l_s
+    for t, (a, b) in enumerate(zip(w_s, w_p)):
+        np.testing.assert_array_equal(b, a, err_msg=f"table {t}")
+
+
+def test_pallas_composes_with_lookahead():
+    """LookaheadEngine(strategy='pallas') at lookahead=1 is bit-exact vs
+    the monolithic pallas step (the drain stage dispatches through the
+    same fused kernels), and compile counts hold at one executable per
+    stage per (plan, batch-shape)."""
+    from distributed_embeddings_tpu.schedule import LookaheadEngine
+
+    specs = [(80, 8, "sum"), (50, 8, "sum")]
+    rng = np.random.RandomState(53)
+    mesh = create_mesh(jax.devices()[:8])
+    weights = [rng.randn(v, w).astype(np.float32) * 0.1
+               for v, w, _ in specs]
+    head = rng.randn(16, 1).astype(np.float32)
+    r2 = np.random.RandomState(59)
+    batches = []
+    for _ in range(4):
+        cats = [jnp.asarray(r2.randint(0, v, size=(BATCH, 2)))
+                for v, _, _ in specs]
+        batches.append((jnp.zeros((BATCH, 1)), cats,
+                        jnp.asarray(r2.randn(BATCH).astype(np.float32))))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    # replicated head, like test_schedule._build: an uncommitted
+    # single-device head would re-specialize the fused step once its
+    # first output comes back replicated
+    head_r = jax.device_put(jnp.asarray(head), NamedSharding(mesh, P()))
+
+    def params_for(model):
+        return {"embedding": model.embedding.set_weights(weights),
+                "head": {"w": head_r}}
+
+    m1 = TinyModel(specs, mesh)
+    init_fn, step_fn = make_sparse_train_step(m1, "adagrad", lr=0.05,
+                                              strategy="pallas")
+    p1 = params_for(m1)
+    s1 = init_fn(p1)
+    mono = []
+    for num, cats, lab in batches:
+        p1, s1, loss = step_fn(p1, s1, num, cats, lab)
+        mono.append(float(loss))
+
+    m2 = TinyModel(specs, mesh)
+    # patch_capacity=BATCH: the compile-stability configuration (the
+    # default capacity overflows at these tiny zipf-free shapes and the
+    # full-reprefetch fallback re-specializes — same posture as
+    # test_schedule.test_compile_count_stable)
+    engine = LookaheadEngine(m2, "adagrad", lr=0.05, strategy="pallas",
+                             patch_capacity=BATCH)
+    p2 = params_for(m2)
+    s2 = engine.init(p2)
+    eng = []
+    for i, b in enumerate(batches):
+        nxt = batches[i + 1] if i + 1 < len(batches) else None
+        p2, s2, loss = engine.step(p2, s2, b, nxt)
+        eng.append(float(loss))
+    assert eng == mono
+    assert engine.compile_counts() == {"prefetch": 1, "fused": 1}
+    for t, (a, b) in enumerate(zip(m1.embedding.get_weights(
+            p1["embedding"]), m2.embedding.get_weights(p2["embedding"]))):
+        np.testing.assert_array_equal(b, a, err_msg=f"table {t}")
+
+
+# ------------------------------------------------- gate + dispatch edges
+def test_kernel_gate_fallback_loud_and_harmless(monkeypatch):
+    """Forced probe failure on a 'TPU' backend: the requested pallas path
+    warns LOUDLY and falls back with NO behavior change (output equals
+    the XLA path bit-for-bit — the gate never silently alters
+    numerics)."""
+    g, table, v, w = _grad_case(11)
+    want, _ = su.sparse_adagrad(table, jnp.full((v, w), 0.1), g, 0.05,
+                                strategy="sort")
+
+    def boom(width):
+        raise RuntimeError("remote_compile HTTP 500 (simulated)")
+
+    gate = su._ShapedKernelGate(boom, "DET_SCATTER_IMPL=pallas (test)")
+    monkeypatch.setattr(su, "_PALLAS_FUSED_GATE", gate)
+    monkeypatch.setattr(su.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(su, "_PALLAS_FALLBACK_WARNED", set())
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        got, _ = su.sparse_adagrad(table, jnp.full((v, w), 0.1), g, 0.05,
+                                   strategy="pallas")
+    msgs = [str(c.message) for c in caught]
+    assert any("failed to compile" in m for m in msgs), msgs
+    assert any("dispatches to the xla path" in m for m in msgs), msgs
+    assert gate.verdicts == {8: False}
+    monkeypatch.setattr(su.jax, "default_backend", lambda: "cpu")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_kernel_gate_shape_class_cache():
+    """One compile-probe verdict per (backend, width shape-class): a
+    second prevalidate at the same class consults the cache instead of
+    re-running the validator."""
+    calls = []
+
+    def validator(cls):
+        calls.append(cls)
+        return True
+
+    gate = su._ShapedKernelGate(validator, "test-gate")
+    assert gate.prevalidate(16)
+    assert gate.prevalidate(12)       # same pow2 class
+    assert gate.prevalidate(100)      # class 128
+    assert calls == [16, 128]
+    assert su._width_class(8) == 8 and su._width_class(9) == 16
+    assert su._width_class(4096) == 512
+
+
+def test_interpret_probe_cached_per_process(monkeypatch):
+    """ISSUE 12 satellite bugfix: the interpret default is probed ONCE
+    per process — a backend flip mid-process can no longer diverge the
+    forward gather and the update kernels within one step."""
+    assert pt._interpret_default(None) is True      # CPU test process
+    monkeypatch.setattr(pt.jax, "default_backend", lambda: "tpu")
+    assert pt._interpret_default(None) is True      # cached, not re-probed
+    assert pt._interpret_default(False) is False    # explicit always wins
+    assert pt._interpret_default(True) is True
+
+
+def test_pallas_requested_env_inert_off_tpu(monkeypatch):
+    """DET_SCATTER_IMPL=pallas via env is TPU-only: CPU runs keep the XLA
+    path under strategy='auto' (the env route must never flip CPU test
+    numerics); explicit strategy='pallas' opts into interpret kernels."""
+    monkeypatch.setenv("DET_SCATTER_IMPL", "pallas")
+    assert not su._pallas_requested("auto")
+    assert su._scatter_route("auto", jnp.zeros((4, 4))) == "xla"
+    assert su._pallas_requested("pallas")
+    assert su._scatter_route("pallas", jnp.zeros((4, 4))) == "pallas"
+    assert su.active_scatter_impl("auto") == "xla"
+    assert su.active_scatter_impl("pallas") == "pallas"
+
+
+def test_gate_verdicts_shape():
+    v = su.gate_verdicts()
+    assert set(v) == {"tiled", "pallas", "pallas-dma"}
+    assert all(x in (-1, 0, 1) for x in v.values())
+
+
+def test_update_consumes_sort_pallas():
+    """The fold planner must know the pallas strategy consumes the
+    forward's canonical sort for ALL optimizer kinds (its dedup rides
+    the artifact), and that explicit sort-strategy sgd now dedups."""
+    for kind in ("sgd", "adagrad", "adam"):
+        assert su.update_consumes_sort(kind, "pallas", 1000, 8)
+    assert su.update_consumes_sort("sgd", "sort", 10**7, 8)
+    assert not su.update_consumes_sort("sgd", "auto", 10**7, 8)
+
+
+def test_pallas_step_hlo_sort_bound():
+    """The lowered pallas-strategy tapped step holds the one-sort-per-
+    exchange-group bound (dedup consumes the folded forward sort), and
+    the fully-fused form (fused forward + pallas update) holds the
+    tiled-forward 2-per-group bound."""
+    import importlib.util as ilu
+    import os
+    spec = ilu.spec_from_file_location(
+        "det_hlo_audit_pf", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "hlo_audit.py"))
+    ha = ilu.module_from_spec(spec)
+    spec.loader.exec_module(ha)
+    rec = ha.audit_tapped_step(vocab=100_000, strategy="pallas")
+    assert rec["hlo_sort"] <= rec["sort_bound"], rec
+    rec2 = ha.audit_tapped_step(vocab=100_000, strategy="pallas",
+                                lookup_path="fused")
+    assert rec2["sort_bound"] == 2 * rec2["n_exchange_groups"]
+    assert rec2["hlo_sort"] <= rec2["sort_bound"], rec2

@@ -69,6 +69,15 @@ DEFAULT_ARMS = (
     ("adam", "sort", None, 0),
     ("sgd", "tiled", None, 0),
     ("adagrad", "tiled", "tiled", 0),
+    # fused pallas strategy (ISSUE 12): the deduped-row tile walk must
+    # consume the folded forward sort — same one-sort-per-group bound as
+    # the sort/tiled arms; the fully-fused arm (fused forward + pallas
+    # update) shares the tiled-forward 2/group bound (the residual
+    # inverse-permute sort)
+    ("adagrad", "pallas", None, 0),
+    ("adam", "pallas", None, 0),
+    ("sgd", "pallas", None, 0),
+    ("adagrad", "pallas", "fused", 0),
     # hot-row replication (ISSUE 4): same sort bound as the hot-less arm —
     # the membership split (searchsorted) and the replicated dense hot
     # update must add ZERO sort instructions per exchange group

@@ -141,6 +141,13 @@ def main() -> int:
               f"fused compiles {g.get('lookahead/compiles{stage=fused}')}")
         check(g.get("train/examples_per_sec", 0) > 0, "examples_per_sec")
         check("exchange/touched_rows_per_step" in g, "exchange gauges")
+        # ISSUE 12: the run must say which sparse-update kernel family
+        # it could dispatch to (-1 = CPU interpret, the expected value
+        # here) and which path the step spans were attributed to
+        check("kernels/gate_verdict{impl=pallas}" in g,
+              "kernel gate-verdict gauges")
+        check(any(k.startswith("span_seconds{span=train/step/update/")
+                  for k in h), "per-strategy update-phase span")
         check(h["span_seconds{span=train/step}"]["count"] == STEPS,
               "train/step span count")
         check(h["serve/request_seconds"]["count"] == REQUESTS,
