@@ -1955,6 +1955,499 @@ def kernels_main(argv=None) -> int:
     return 0 if "kernels_error" not in record else 1
 
 
+# ------------------------------------------------------------- soak mode
+# (ISSUE 13 / ROADMAP item 5) The composed production soak: the ingest
+# pipeline feeds fit(lookahead=, vocab=, store=) publishing row deltas
+# while a fleet of InferenceEngine replicas consumes them mid-query —
+# under scripted adversarial scenarios (tools/soak_scenarios/*.json:
+# zipf drift, flash crowds, late-join re-anchor, publisher pause, and
+# deterministic fault plans from distributed_embeddings_tpu/faults/)
+# with SLO accounting through the obs registry (tools/slo_soak.json).
+
+SOAK_SCENARIO_DEFAULTS = {
+    "steps": 16, "batch": 192, "tables": 2, "vocab": 1500, "width": 8,
+    "hotness": 2, "world": 8, "optimizer": "adagrad", "lr": 0.05,
+    "alpha": 1.2, "seed": 0,
+    "publish_every": 2, "snapshot_every": 3, "lookahead": 1,
+    "vocab_manage": None,
+    "replicas": 2, "requests_per_round": 2, "request_batch": 16,
+    "poll_every_rounds": 1, "late_join": None,
+    "traffic": None, "fault_plan": None,
+}
+
+_SOAK_VOCAB_DEFAULTS = {"slack": 192, "admit_threshold": 1,
+                        "decay": 0.97, "every": 4, "key_space": 4000}
+
+
+def load_soak_scenario(path_or_doc) -> dict:
+    """Load + validate one soak scenario (a JSON file path or a dict).
+    Scenarios are DATA, not code (ROADMAP item 5): unknown keys refuse,
+    the fault plan's specs are constructed (so a scenario naming an
+    impossible (point, kind) pair fails at load, not mid-soak), and the
+    lookahead x vocab-maintenance composition refusal is checked here
+    with the same rule `training.fit` enforces."""
+    if isinstance(path_or_doc, str):
+        with open(path_or_doc) as f:
+            doc = json.load(f)
+    else:
+        doc = dict(path_or_doc)
+    if "name" not in doc:
+        raise ValueError("soak scenario needs a 'name'")
+    unknown = set(doc) - set(SOAK_SCENARIO_DEFAULTS) - {"name",
+                                                        "description"}
+    if unknown:
+        raise ValueError(f"soak scenario {doc['name']!r}: unknown keys "
+                         f"{sorted(unknown)}")
+    sc = {**SOAK_SCENARIO_DEFAULTS, **doc}
+    for k in ("steps", "batch", "tables", "vocab", "width", "hotness",
+              "replicas", "publish_every", "request_batch"):
+        if int(sc[k]) <= 0:
+            raise ValueError(f"soak scenario {sc['name']!r}: {k} must "
+                             f"be positive, got {sc[k]}")
+    if sc["vocab_manage"] is not None:
+        vm = {**_SOAK_VOCAB_DEFAULTS, **sc["vocab_manage"]}
+        sc["vocab_manage"] = vm
+        if sc["lookahead"] and vm["every"]:
+            raise ValueError(
+                f"soak scenario {sc['name']!r}: lookahead>0 composes "
+                "only with translate-only vocab (vocab_manage.every == "
+                "0) — the same refusal training.fit enforces")
+    if sc["late_join"] is not None:
+        lj = {"replica": int(sc["replicas"]) - 1, "at_frac": 0.5,
+              **sc["late_join"]}
+        if not 1 <= int(lj["replica"]) < int(sc["replicas"]):
+            raise ValueError(
+                f"soak scenario {sc['name']!r}: late_join.replica must "
+                "be in [1, replicas) — replica 0 serves from the start")
+        sc["late_join"] = lj
+    if sc["fault_plan"] is not None:
+        from distributed_embeddings_tpu import faults
+        faults.FaultPlan.from_json(sc["fault_plan"])   # spec validation
+    return sc
+
+
+class _SoakTraffic:
+    """Deterministic scenario traffic: zipf-ranked ids per table with
+    phase-scripted drift (alpha changes, universe rotation) and
+    flash-crowd bursts. One instance per role (trainer / serving fleet),
+    each on its own seeded RandomState."""
+
+    def __init__(self, scenario: dict, universe: int, key_base: int, rng):
+        self.sc = scenario
+        self.universe = int(universe)
+        self.key_base = int(key_base)
+        self.rng = rng
+        self.phases = scenario.get("traffic") or [{}]
+        self._probs = {}
+
+    def _prob(self, alpha: float):
+        p = self._probs.get(alpha)
+        if p is None:
+            ranks = np.arange(1, self.universe + 1, dtype=np.float64)
+            p = ranks ** -float(alpha)
+            p /= p.sum()
+            self._probs[alpha] = p
+        return p
+
+    def phase_at(self, frac: float) -> dict:
+        for ph in self.phases:
+            if frac <= float(ph.get("until_frac", 1.0)) + 1e-9:
+                return ph
+        return self.phases[-1]
+
+    def ids(self, n: int, frac: float) -> np.ndarray:
+        ph = self.phase_at(frac)
+        alpha = float(ph.get("alpha", self.sc["alpha"]))
+        ids = self.rng.choice(self.universe, size=n, p=self._prob(alpha))
+        rot = int(ph.get("rotate", 0))
+        if rot:
+            ids = (ids + rot) % self.universe
+        fc = ph.get("flash_crowd")
+        if fc:
+            burst = self.rng.random_sample(n) < float(fc.get("frac", 0.5))
+            hot = self.rng.randint(0, max(int(fc.get("keys", 8)), 1),
+                                   size=n)
+            ids = np.where(burst, (rot + hot) % self.universe, ids)
+        return self.key_base + ids.astype(np.int64)
+
+    def batch(self, batch: int, hotness: int, tables: int, frac: float,
+              dtype) -> tuple:
+        cats = [self.ids(batch * hotness, frac)
+                .reshape(batch, hotness).astype(dtype)
+                for _ in range(tables)]
+        num = np.zeros((batch, 1), np.float32)
+        lab = self.rng.randn(batch).astype(np.float32)
+        return num, cats, lab
+
+
+def run_soak_bench(scenario: dict) -> dict:
+    """One composed soak run (see module comment above). Returns the
+    record; the acceptance gates ride as ``soak/*`` gauges on the
+    default registry so tools/slo_soak.json can address them:
+
+      * ``soak/poll_exceptions_escaped`` — exceptions that escaped
+        `InferenceEngine.poll_updates` across the whole run (must be 0:
+        consumer-side faults degrade, they never crash serving);
+      * ``soak/quarantine_unreconciled`` — symmetric difference between
+        the fleet's quarantined files and the fault plan's
+        corrupt-published files (0 = every injected corruption was
+        caught, nothing healthy was quarantined);
+      * ``soak/orphan_tmp_unreconciled`` — |orphaned tmp files| vs
+        |injected crashes| mismatch (0 = crashes leak exactly their tmp
+        file, swept afterwards);
+      * ``soak/parity_max_dev`` — max |publisher - replica| over every
+        table after the post-fault recovery snapshot (0.0 = bit-exact).
+    """
+    import shutil
+    import tempfile
+
+    from distributed_embeddings_tpu import faults
+
+    pub_dir = tempfile.mkdtemp(prefix="det_soak_")
+    try:
+        return _run_soak_bench_inner(scenario, pub_dir)
+    finally:
+        # safety net: a failure ANYWHERE (replica construction, record
+        # assembly) must not leave the adversarial plan installed
+        # process-wide or the stream dir on disk — both idempotent
+        # against the inner function's own mid-run cleanup
+        faults.set_plan(None)
+        shutil.rmtree(pub_dir, ignore_errors=True)
+
+
+def _run_soak_bench_inner(scenario: dict, pub_dir: str) -> dict:
+    from distributed_embeddings_tpu import faults, obs, training
+    from distributed_embeddings_tpu.parallel.mesh import create_mesh
+    from distributed_embeddings_tpu.serving import InferenceEngine
+    from distributed_embeddings_tpu.store import TableStore
+    from distributed_embeddings_tpu.utils import checkpoint as ckpt_lib
+
+    sc = scenario
+    _ha = _load_hlo_audit()
+    devs = jax.devices()
+    world = min(int(sc["world"]), len(devs))
+    if world < 2:
+        return {"metric": "soak_composed", "soak_error":
+                f"soak needs a multi-device mesh, have {len(devs)} "
+                "device(s)", "git_sha": _git_sha()}
+    mesh = create_mesh(devs[:world])
+    reg = obs.default_registry()
+    seed = int(sc["seed"])
+    vm = sc["vocab_manage"]
+    tables, vocab_rows = int(sc["tables"]), int(sc["vocab"])
+    width, hotness = int(sc["width"]), int(sc["hotness"])
+    steps, batch = int(sc["steps"]), int(sc["batch"])
+
+    def build():
+        return _ha._build_model(
+            vocab_rows, width, "sum", tables=tables, mesh=mesh,
+            vocab_slack=(int(vm["slack"]) if vm else 0))
+
+    model = build()
+    emb = model.embedding
+    params = {"embedding": emb.init(jax.random.PRNGKey(seed))}
+    pub_store = TableStore(emb, params["embedding"],
+                           snapshot_every=int(sc["snapshot_every"]))
+    mgr = None
+    if vm:
+        from distributed_embeddings_tpu.vocab import VocabManager
+        mgr = VocabManager(emb,
+                           admit_threshold=int(vm["admit_threshold"]),
+                           decay=float(vm["decay"]))
+    plan = (faults.FaultPlan.from_json(sc["fault_plan"])
+            if sc["fault_plan"] else None)
+    faults.set_plan(plan)
+
+    # raw keys when vocab-managed (the manager owns the binding),
+    # in-range physical ids otherwise
+    universe = int(vm["key_space"]) if vm else vocab_rows
+    key_base = 10 ** 8 if vm else 0
+    id_dtype = np.int64 if vm else np.int32
+    traffic = _SoakTraffic(sc, universe, key_base,
+                           np.random.RandomState(seed))
+    serve_traffic = _SoakTraffic(sc, universe, key_base,
+                                 np.random.RandomState(seed + 999))
+
+    def train_batches():
+        for s in range(steps):
+            yield traffic.batch(batch, hotness, tables,
+                                (s + 1) / steps, id_dtype)
+
+    # ---- replica fleet ------------------------------------------------
+    # The fleet serves and polls from a fit CALLBACK (after each step's
+    # sync point) rather than a competing thread: XLA:CPU's in-process
+    # collectives deadlock when two threads interleave different meshed
+    # programs over the same virtual devices, and single-threaded
+    # dispatch also makes the fault plan's occurrence ordering — and
+    # therefore the whole soak — deterministically replayable. The
+    # replicas still consume MID-STREAM: deltas apply between training
+    # steps, queries run against every intermediate version.
+    escapes = []
+    degraded_seen = set()
+    replicas = [None] * int(sc["replicas"])
+
+    def make_replica(i: int) -> InferenceEngine:
+        remb = build().embedding
+        rvocab = None
+        if vm:
+            from distributed_embeddings_tpu.vocab import VocabManager
+            rvocab = VocabManager(
+                remb, admit_threshold=int(vm["admit_threshold"]),
+                decay=float(vm["decay"]))
+        return InferenceEngine(
+            remb, remb.init(jax.random.PRNGKey(seed + 100 + i)),
+            vocab_manager=rvocab, registry=reg)
+
+    lj = sc["late_join"]
+    for i in range(len(replicas)):
+        if lj is None or i != int(lj["replica"]):
+            replicas[i] = make_replica(i)
+
+    req_hist = reg.histogram("serve/request_seconds")
+    rb = int(sc["request_batch"])
+
+    def safe_poll(eng: InferenceEngine):
+        """poll_updates NEVER raising is itself an acceptance gate —
+        count anything that escapes instead of crashing the soak."""
+        try:
+            eng.poll_updates(pub_dir)
+        except Exception as e:  # noqa: BLE001 - the gate counts these
+            escapes.append(f"{type(e).__name__}: {e}"[:200])
+        degraded_seen.update(eng.degraded_reasons())
+
+    def serve_round(frac: float):
+        for eng in replicas:
+            if eng is None:
+                continue
+            for _ in range(int(sc["requests_per_round"])):
+                req = [serve_traffic.ids(rb * hotness, frac)
+                       .reshape(rb, hotness).astype(id_dtype)
+                       for _ in range(tables)]
+                t0 = time.perf_counter()
+                out = eng.predict(req)
+                # materialize: the latency is dispatch + execution, and
+                # no serving program stays in flight when the next train
+                # step's collectives dispatch
+                for o in out:
+                    np.asarray(o)
+                req_hist.record(time.perf_counter() - t0)
+
+    state = {"rounds": 0}
+    poll_every = max(int(sc["poll_every_rounds"]), 1)
+
+    class _FleetCallback:
+        def on_step(self, step, p, loss):
+            frac = (step + 1) / max(steps, 1)
+            if lj is not None and replicas[int(lj["replica"])] is None \
+                    and frac >= float(lj["at_frac"]):
+                # late join: a fresh replica re-anchors from the newest
+                # snapshot mid-churn (the existing snapshot-fallback
+                # path; its first poll applies snapshot + chained
+                # deltas)
+                replicas[int(lj["replica"])] = make_replica(
+                    int(lj["replica"]))
+            serve_round(frac)
+            if state["rounds"] % poll_every == 0:
+                for eng in replicas:
+                    if eng is not None:
+                        safe_poll(eng)
+            state["rounds"] += 1
+
+    fit_result = {}
+    try:
+        p, o, h = training.fit(
+            model, params, train_batches(), steps=steps,
+            optimizer=sc["optimizer"], lr=float(sc["lr"]),
+            log_every=0, callbacks=[_FleetCallback()],
+            store=pub_store, publish_every=int(sc["publish_every"]),
+            publish_dir=pub_dir, vocab=mgr,
+            vocab_every=(int(vm["every"]) if vm else 16),
+            lookahead=int(sc["lookahead"]), registry=reg)
+        fit_result["params"], fit_result["opt"] = p, o
+        fit_result["history"] = h
+    except Exception as e:  # noqa: BLE001 - surfaced in the record
+        import traceback
+        traceback.print_exc()
+        fit_result["error"] = f"{type(e).__name__}: {e}"[:300]
+    finally:
+        # the fault window closes with the training run: recovery and
+        # the final parity audit run on a healthy filesystem
+        faults.set_plan(None)
+    rounds = state["rounds"]
+
+    record = {
+        "metric": "soak_composed",
+        "backend": devs[0].platform,
+        "soak_scenario": sc["name"],
+        "soak_steps": steps, "soak_batch": batch,
+        "soak_tables": tables, "soak_vocab": vocab_rows,
+        "soak_width": width, "soak_world": world,
+        "soak_lookahead": int(sc["lookahead"]),
+        "soak_vocab_managed": bool(vm),
+        "soak_replicas": len(replicas),
+        "soak_rounds": rounds,
+        "git_sha": _git_sha(),
+    }
+    if "error" in fit_result:
+        record["soak_error"] = fit_result["error"]
+        return record
+    history = fit_result["history"]
+
+    # ---- recovery: one clean snapshot re-anchors every replica --------
+    orphans = [n for n in os.listdir(pub_dir) if ".tmp" in n]
+    pub_store.commit(fit_result["params"]["embedding"],
+                     fit_result["opt"]["emb"])
+    if mgr is not None:
+        from distributed_embeddings_tpu.vocab import vocab_state_path
+        mgr.save_state(vocab_state_path(pub_dir, pub_store.version),
+                       full=False)
+    recovery = pub_store.publish(pub_dir, force_snapshot=True)
+    for i in range(len(replicas)):
+        if replicas[i] is None:        # late joiner the run never reached
+            replicas[i] = make_replica(i)
+        safe_poll(replicas[i])
+        safe_poll(replicas[i])         # second poll: drain any stragglers
+
+    # ---- parity: bit-exact fleet at the recovered version -------------
+    want = [np.asarray(w) for w in pub_store.get_weights()]
+    parity = 0.0
+    for eng in replicas:
+        for a, b in zip(want, eng.store.get_weights()):
+            if a.size:
+                parity = max(parity, float(np.max(np.abs(
+                    a - np.asarray(b)))))
+
+    # ---- reconciliation against the fault plan's ledger ---------------
+    injected_corrupt = set(plan.corrupted_paths("store.publish")) \
+        if plan else set()
+    union_quarantined = set()
+    retries_total = 0
+    replica_stats = []
+    for eng in replicas:
+        cons = eng._consumers.get(pub_dir)
+        if cons is not None:
+            union_quarantined |= set(cons.quarantined)
+            retries_total += cons._retries_total
+        st = eng.update_stats(pub_dir)
+        replica_stats.append({k: st.get(k) for k in (
+            "applied", "applied_deltas", "applied_snapshots", "version",
+            "quarantined_files", "poll_retries",
+            "staleness_versions_max", "staleness_s_max")})
+    crash_fires = plan.counts(kind="crash_before_rename") if plan else 0
+    swept = ckpt_lib.sweep_orphan_tmp(pub_dir)
+    injected_by_kind = {}
+    if plan is not None:
+        for e in plan.events:
+            injected_by_kind[e["kind"]] = \
+                injected_by_kind.get(e["kind"], 0) + 1
+
+    published = history.get("published", [])
+    summ = req_hist.summary()
+    record.update({
+        "soak_publishes": len([i for i in published
+                               if i["kind"] != "paused"]),
+        "soak_paused_publishes": len([i for i in published
+                                      if i["kind"] == "paused"]),
+        "soak_publish_crashes": len(history.get("publish_crashes", [])),
+        "soak_recovery_version": recovery["version"],
+        "soak_parity_max_dev": parity,
+        "soak_injected_faults": injected_by_kind,
+        "soak_injected_corrupt_files": len(injected_corrupt),
+        "soak_quarantined_files": len(union_quarantined),
+        "soak_quarantine_unreconciled": len(
+            union_quarantined.symmetric_difference(injected_corrupt)),
+        "soak_orphan_tmp_files": len(orphans),
+        "soak_orphan_swept": len(swept),
+        "soak_orphan_tmp_unreconciled": abs(len(orphans) - crash_fires),
+        "soak_poll_exceptions_escaped": len(escapes),
+        "soak_poll_escape_examples": escapes[:5],
+        "soak_degraded_reasons_seen": sorted(degraded_seen),
+        "soak_poll_retries_total": retries_total,
+        "soak_replica_stats": replica_stats,
+        "soak_serve_p50_ms": summ["p50_ms"],
+        "soak_serve_p99_ms": summ["p99_ms"],
+        "soak_serve_requests": summ["count"],
+        "soak_fault_events": (plan.events[:50] if plan else []),
+    })
+    if sc["lookahead"]:
+        record["soak_compile_counts"] = {
+            "prefetch": reg.gauge("lookahead/compiles",
+                                  stage="prefetch").value,
+            "fused": reg.gauge("lookahead/compiles", stage="fused").value,
+        }
+    if "vocab_stats" in history:
+        record["soak_vocab_stats"] = history["vocab_stats"]
+    if "ingest_stages" in history:
+        record["soak_ingest_bottleneck"] = max(
+            history["ingest_stages"],
+            key=lambda k: history["ingest_stages"][k]["mean_ms"])
+
+    # the SLO-addressable acceptance gauges (tools/slo_soak.json)
+    reg.gauge("soak/parity_max_dev").set(parity)
+    reg.gauge("soak/quarantine_unreconciled").set(
+        record["soak_quarantine_unreconciled"])
+    reg.gauge("soak/orphan_tmp_unreconciled").set(
+        record["soak_orphan_tmp_unreconciled"])
+    reg.gauge("soak/poll_exceptions_escaped").set(len(escapes))
+    return record
+
+
+def soak_main(argv=None) -> int:
+    """`bench.py --mode soak` entry point: one JSON line, like main()."""
+    import argparse
+    p = argparse.ArgumentParser(
+        description="composed production soak (ROADMAP item 5)")
+    p.add_argument("--mode", choices=["soak"], default="soak")
+    p.add_argument("--scenario", required=True,
+                   help="scenario JSON file (tools/soak_scenarios/)")
+    p.add_argument("--steps", type=int, default=None,
+                   help="override the scenario's step count")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="override the scenario's replica count")
+    args = p.parse_args(argv)
+    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        scenario = load_soak_scenario(args.scenario)
+        if args.steps is not None:
+            scenario["steps"] = args.steps
+        if args.replicas is not None:
+            scenario["replicas"] = args.replicas
+        if args.steps is not None or args.replicas is not None:
+            # re-validate: overrides must hit the same positivity and
+            # composition refusals the scenario file does (an explicit
+            # --steps 0 is an error, not "no override")
+            scenario = load_soak_scenario(scenario)
+        _load_hlo_audit()._ensure_world(max(2, int(scenario["world"])))
+        record = run_soak_bench(scenario)
+    except Exception as e:  # noqa: BLE001 - one JSON line, like main()
+        import traceback
+        traceback.print_exc()
+        record = {"metric": "soak_composed",
+                  "soak_error": str(e)[:300], "git_sha": _git_sha()}
+    record = _stamp_audit_findings(record)
+    try:
+        # the audit result doubles as the `audit/findings` gauge so the
+        # SLO rule file gates it alongside the soak gauges (the
+        # obs_smoke idiom)
+        from distributed_embeddings_tpu.obs import default_registry
+        af = record.get("audit_findings", {})
+        default_registry().gauge("audit/findings").set(
+            af["count"] if isinstance(af, dict) and "count" in af else -1)
+    except Exception:  # noqa: BLE001 - accounting must not kill the bench
+        pass
+    record = _stamp_metrics_snapshot(record)
+    print(json.dumps(record))
+    ok = ("soak_error" not in record
+          and record.get("soak_poll_exceptions_escaped", 1) == 0
+          and record.get("soak_quarantine_unreconciled", 1) == 0
+          and record.get("soak_parity_max_dev", 1.0) == 0.0)
+    slo = record.get("slo_findings")
+    if isinstance(slo, dict) and slo.get("count"):
+        ok = False
+    return 0 if ok else 1
+
+
 # ---------------------------------------------------------------- roofline
 # v5e per-chip peaks (public spec); used only for the efficiency estimate.
 HBM_GBPS = {"v5e": 819.0, "v5p": 2765.0, "v4": 1228.0}
@@ -2446,6 +2939,8 @@ if __name__ == "__main__":
         sys.exit(lookahead_main(sys.argv[1:]))
     elif _cli_mode() == "kernels":
         sys.exit(kernels_main(sys.argv[1:]))
+    elif _cli_mode() == "soak":
+        sys.exit(soak_main(sys.argv[1:]))
     elif os.environ.get("DET_BENCH_INNER") == "1":
         main()
     else:

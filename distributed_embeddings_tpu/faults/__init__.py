@@ -1,0 +1,20 @@
+"""Deterministic fault-injection seam (ISSUE 13) — see `faults.plan`.
+
+Inert unless a `FaultPlan` is installed (``DET_FAULT_PLAN`` env or
+`set_plan`/`use_plan`); the IO seams in store/, vocab/, serving/ and
+utils/pipeline.py call `check`/`check_raise`/`filter_scan` and degrade
+per docs/serving.md "Failure modes & degradation".
+"""
+
+from distributed_embeddings_tpu.faults.plan import (  # noqa: F401
+    CORRUPTING_KINDS, KINDS, POINTS, FaultError, FaultPlan, FaultSpec,
+    InjectedCrash, InjectedIOError, active_plan, check, check_raise,
+    corrupt_file, filter_scan, reset_plan, set_plan, use_plan)
+
+__all__ = [
+    "CORRUPTING_KINDS", "KINDS", "POINTS",
+    "FaultError", "FaultPlan", "FaultSpec",
+    "InjectedCrash", "InjectedIOError",
+    "active_plan", "check", "check_raise", "corrupt_file", "filter_scan",
+    "reset_plan", "set_plan", "use_plan",
+]

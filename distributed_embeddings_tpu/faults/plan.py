@@ -1,0 +1,391 @@
+"""Deterministic fault injection for the streaming/serving spine (ISSUE 13).
+
+The train->serve path (store/ publishers, vocab sidecars, DeltaConsumer
+replicas, the ingest pipeline) assumed a benign filesystem and a
+crash-free publisher. This module is the adversary: a seed-driven
+`FaultPlan` whose injection points wrap the EXISTING IO seams — nothing
+here changes behavior unless a plan is installed, and every decision a
+plan makes is a pure function of (seed, call sequence), so a soak run
+that found a degradation replays bit-identically from its scenario file.
+
+Injection points (the seam calls `faults.check(point, ...)` /
+`faults.filter_scan(point, files)`):
+
+  * ``store.publish``     — `TableStore.publish`'s write+rename. Kinds:
+    ``truncate`` / ``bit_flip`` (the renamed-in file is corrupt — the
+    torn/partial-write classes), ``crash_before_rename`` (the tmp file
+    is orphaned, the stream file never appears; raises `InjectedCrash`,
+    which `training.fit`'s publisher catches and survives), ``pause``
+    (the publish is skipped entirely — publisher pause/resume).
+  * ``vocab.save_state``  — the vocab sidecar writer; same write kinds.
+  * ``store.scan``        — `scan_published`. Kind ``delay_visibility``:
+    a newly published file stays invisible to consumers for N scans
+    (NFS/FUSE-style lagging directory views).
+  * ``store.load``        — `load_row_delta`/`load_row_delta_meta`.
+    Kind ``io_error``: raise `InjectedIOError` (an `OSError`) —
+    the transient-read class the consumer retries with backoff.
+  * ``consumer.poll``     — `DeltaConsumer.poll` entry; ``io_error``.
+  * ``ingest.stage``      — ingest-pipeline stage bodies; ``io_error``
+    (the stage worker retries transient errors in place).
+
+A plan is data:  ``{"seed": 7, "faults": [{"point": "store.publish",
+"kind": "bit_flip", "at": [1]}, ...]}`` — installed via the
+``DET_FAULT_PLAN`` env var (inline JSON or a path to a JSON file) or
+the `set_plan`/`use_plan` API. Each spec fires on explicit 0-based
+occurrence indices (``at`` + optional ``repeat``) or on a seeded
+per-occurrence Bernoulli draw (``prob``), capped by ``max_fires``.
+Every firing lands in ``plan.events`` — the ledger the soak harness
+reconciles quarantine/retry/orphan counts against.
+"""
+
+import json
+import os
+import threading
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "KINDS", "POINTS", "FaultError", "InjectedCrash", "InjectedIOError",
+    "FaultSpec", "FaultPlan", "active_plan", "set_plan", "reset_plan",
+    "use_plan", "check", "check_raise", "filter_scan", "corrupt_file",
+]
+
+KINDS = ("truncate", "bit_flip", "crash_before_rename", "pause",
+         "delay_visibility", "io_error")
+
+# which kinds are meaningful at which seam — a spec outside this table is
+# a scenario bug and refuses at construction (a fault that can never fire
+# would silently void the reconciliation ledger)
+POINTS: Dict[str, Tuple[str, ...]] = {
+    "store.publish": ("truncate", "bit_flip", "crash_before_rename",
+                      "pause"),
+    "vocab.save_state": ("truncate", "bit_flip", "crash_before_rename"),
+    "store.scan": ("delay_visibility",),
+    "store.load": ("io_error",),
+    "consumer.poll": ("io_error",),
+    "ingest.stage": ("io_error",),
+}
+
+# kinds that leave a CORRUPT published file behind (the quarantine set a
+# soak reconciles against); crash/pause leave no stream file at all
+CORRUPTING_KINDS = ("truncate", "bit_flip")
+
+
+class FaultError(RuntimeError):
+    """Base of all injected failures."""
+
+
+class InjectedCrash(FaultError):
+    """Simulated publisher crash between write and rename. The tmp file
+    is left orphaned on disk; callers that model a restartable publisher
+    (`training.fit`, the soak harness) catch THIS type only — real
+    exceptions still propagate."""
+
+
+class InjectedIOError(OSError, FaultError):
+    """Simulated transient read error — an `OSError`, so it takes the
+    same retry/backoff path real filesystem flakes do."""
+
+
+class FaultSpec:
+    """One fault rule: where (`point`), what (`kind`), when (`at`
+    occurrence indices + `repeat` width, or Bernoulli `prob`), how often
+    at most (`max_fires`), and a kind-specific `arg` (truncate fraction,
+    bit-flip offset fraction, delay-visibility scan count)."""
+
+    __slots__ = ("point", "kind", "at", "repeat", "prob", "max_fires",
+                 "arg", "fires", "_rng", "_delay")
+
+    _ARG_DEFAULT = {"truncate": 0.5, "bit_flip": 0.6,
+                    "delay_visibility": 3}
+
+    def __init__(self, point: str, kind: str,
+                 at: Optional[Sequence[int]] = None, repeat: int = 1,
+                 prob: float = 0.0, max_fires: Optional[int] = None,
+                 arg: Optional[float] = None, seed: int = 0):
+        if point not in POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r} (one of {sorted(POINTS)})")
+        if kind not in POINTS[point]:
+            raise ValueError(
+                f"fault kind {kind!r} cannot fire at point {point!r} "
+                f"(supported there: {POINTS[point]})")
+        if at is None and not prob:
+            raise ValueError(
+                f"fault ({point}, {kind}): need 'at' occurrence indices "
+                "or a 'prob' > 0 — a spec with neither never fires")
+        if at is not None and (not hasattr(at, "__iter__")
+                               or isinstance(at, (str, bytes))):
+            raise ValueError(f"fault ({point}, {kind}): 'at' must be a "
+                             f"list of occurrence indices, got {at!r}")
+        self.point = point
+        self.kind = kind
+        self.at = None if at is None else sorted(int(a) for a in at)
+        self.repeat = max(int(repeat), 1)
+        self.prob = float(prob)
+        self.max_fires = None if max_fires is None else int(max_fires)
+        self.arg = self._ARG_DEFAULT.get(kind) if arg is None else arg
+        self.fires = 0
+        self._rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        # delay_visibility state: distinct-file index assignment and
+        # per-path remaining-hidden scan counts
+        self._delay = {"next_idx": 0, "seen": {}, "hiding": {}}
+
+    def budget_left(self) -> bool:
+        return self.max_fires is None or self.fires < self.max_fires
+
+    def wants(self, occurrence: int) -> bool:
+        """Pure decision for one occurrence index. `at`-triggered specs
+        are fully deterministic; `prob` specs draw from the spec's own
+        seeded stream (deterministic per seed AND call sequence)."""
+        if self.at is not None:
+            return any(a <= occurrence < a + self.repeat for a in self.at)
+        return bool(self._rng.random_sample() < self.prob)
+
+    def to_dict(self) -> dict:
+        return {"point": self.point, "kind": self.kind, "at": self.at,
+                "repeat": self.repeat, "prob": self.prob,
+                "max_fires": self.max_fires, "arg": self.arg,
+                "fires": self.fires}
+
+
+class FaultPlan:
+    """A seed + an ordered list of `FaultSpec`s, with per-point
+    occurrence counters and the event ledger. Thread-safe: publisher and
+    consumer threads share one plan in a soak run."""
+
+    def __init__(self, faults: Sequence[dict], seed: int = 0):
+        self.seed = int(seed)
+        self.specs: List[FaultSpec] = []
+        for i, f in enumerate(faults):
+            f = dict(f)
+            f.pop("seed", None)
+            self.specs.append(FaultSpec(seed=self.seed * 1000003 + i, **f))
+        self._occ: Dict[str, int] = {}
+        self.events: List[dict] = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ loading
+    @classmethod
+    def from_json(cls, doc) -> "FaultPlan":
+        """Build from a dict, an inline JSON string, or a path to a JSON
+        file (the three forms `DET_FAULT_PLAN` accepts)."""
+        if isinstance(doc, str):
+            text = doc.strip()
+            if text.startswith("@"):
+                with open(text[1:]) as f:
+                    doc = json.load(f)
+            elif text.startswith("{") or text.startswith("["):
+                doc = json.loads(text)
+            else:
+                with open(text) as f:
+                    doc = json.load(f)
+        if isinstance(doc, list):
+            doc = {"faults": doc}
+        if not isinstance(doc, dict):
+            raise ValueError(f"fault plan must be a dict, got {type(doc)}")
+        return cls(doc.get("faults", []), seed=doc.get("seed", 0))
+
+    def to_dict(self) -> dict:
+        return {"seed": self.seed,
+                "faults": [s.to_dict() for s in self.specs]}
+
+    # ------------------------------------------------------------ firing
+    def check(self, point: str, **ctx) -> Optional[FaultSpec]:
+        """Advance `point`'s occurrence counter and return the first
+        matching spec that fires (None = proceed normally). The firing
+        is appended to `events` with the context the seam passed."""
+        with self._lock:
+            occ = self._occ.get(point, 0)
+            self._occ[point] = occ + 1
+            for spec in self.specs:
+                if spec.point != point or spec.kind == "delay_visibility":
+                    continue
+                if not spec.budget_left():
+                    continue
+                if spec.wants(occ):
+                    spec.fires += 1
+                    # ctx keys must not clobber the ledger's identity
+                    # fields — reconciliation reads event["kind"] —
+                    # and "path" stays untruncated: `corrupted_paths`
+                    # must compare equal to the consumer's quarantine
+                    # keys, which are full filesystem paths
+                    self.events.append(
+                        {**{k: (str(v) if k == "path"
+                                else str(v)[:160])
+                            for k, v in ctx.items()},
+                         "point": point, "kind": spec.kind,
+                         "occurrence": occ})
+                    return spec
+            return None
+
+    def filter_scan(self, point: str, files: Sequence[tuple]
+                    ) -> List[tuple]:
+        """Delayed-visibility filter over `scan_published`-shaped
+        ``(version, kind, path)`` tuples: the spec's `at`/`prob` decides
+        PER DISTINCT FILE (in first-seen order) whether that file is
+        hidden, and `arg` is how many subsequent scans it stays hidden."""
+        specs = [s for s in self.specs
+                 if s.point == point and s.kind == "delay_visibility"]
+        if not specs:
+            return list(files)
+        with self._lock:
+            visible = []
+            for f in files:
+                path = f[-1]
+                hidden = False
+                for spec in specs:
+                    st = spec._delay
+                    if path not in st["seen"]:
+                        idx = st["next_idx"]
+                        st["next_idx"] = idx + 1
+                        st["seen"][path] = idx
+                        if spec.budget_left() and spec.wants(idx):
+                            spec.fires += 1
+                            st["hiding"][path] = max(int(spec.arg), 1)
+                            self.events.append(
+                                {"point": point,
+                                 "kind": "delay_visibility",
+                                 "occurrence": idx, "path": path,
+                                 "scans": int(spec.arg)})
+                    rem = st["hiding"].get(path, 0)
+                    if rem > 0:
+                        st["hiding"][path] = rem - 1
+                        hidden = True
+                if not hidden:
+                    visible.append(f)
+            return visible
+
+    # ---------------------------------------------------------- ledger
+    def counts(self, point: Optional[str] = None,
+               kind: Optional[str] = None) -> int:
+        return sum(1 for e in self.events
+                   if (point is None or e["point"] == point)
+                   and (kind is None or e["kind"] == kind))
+
+    def corrupted_paths(self, point: str = "store.publish") -> List[str]:
+        """Final stream paths this plan corrupted on disk (the set a
+        soak reconciles consumer quarantines against)."""
+        return sorted({e["path"] for e in self.events
+                       if e["point"] == point
+                       and e["kind"] in CORRUPTING_KINDS and "path" in e})
+
+
+# --------------------------------------------------------- global plumbing
+_UNSET = object()
+_active = _UNSET
+_active_lock = threading.Lock()
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The installed plan: `set_plan`'s argument if one was set, else a
+    plan parsed ONCE from ``DET_FAULT_PLAN`` (inline JSON / ``@path`` /
+    path), else None. The common no-plan path is one attribute read."""
+    global _active
+    if _active is _UNSET:
+        with _active_lock:
+            if _active is _UNSET:
+                env = os.environ.get("DET_FAULT_PLAN")
+                _active = FaultPlan.from_json(env) if env else None
+    return _active
+
+
+def set_plan(plan: Optional[FaultPlan]) -> None:
+    """Install `plan` process-wide (None = explicitly no faults,
+    shadowing the env var until `reset_plan`)."""
+    global _active
+    with _active_lock:
+        _active = plan
+
+
+def reset_plan() -> None:
+    """Forget any installed plan; the next `active_plan()` re-reads
+    ``DET_FAULT_PLAN``."""
+    global _active
+    with _active_lock:
+        _active = _UNSET
+
+
+@contextmanager
+def use_plan(plan: Optional[FaultPlan]):
+    """Scoped install (tests / bench scenarios): restores the previous
+    plan state on exit."""
+    global _active
+    with _active_lock:
+        prev = _active
+        _active = plan
+    try:
+        yield plan
+    finally:
+        with _active_lock:
+            _active = prev
+
+
+def check(point: str, **ctx) -> Optional[FaultSpec]:
+    plan = active_plan()
+    return plan.check(point, **ctx) if plan is not None else None
+
+
+def check_raise(point: str, **ctx) -> Optional[FaultSpec]:
+    """`check`, raising `InjectedIOError` when an ``io_error`` spec
+    fires — the one-liner read seams use."""
+    spec = check(point, **ctx)
+    if spec is not None and spec.kind == "io_error":
+        where = ctx.get("path") or ctx.get("stage") or ""
+        raise InjectedIOError(
+            f"{point}: injected transient IOError"
+            + (f" ({where})" if where else ""))
+    return spec
+
+
+def filter_scan(point: str, files: Sequence[tuple]) -> List[tuple]:
+    plan = active_plan()
+    return plan.filter_scan(point, files) if plan is not None \
+        else list(files)
+
+
+def _payload_window(path: str) -> Tuple[int, int]:
+    """(start, size) of the LAST non-metadata member's data region in a
+    zip/npz file — the deterministic target region for injected damage
+    (a flip in zip slack bytes like an extra field would be invisible to
+    both the member CRCs and the container checksums: corruption that
+    changes nothing is not a fault). Falls back to the whole file when
+    the zip structure cannot be parsed."""
+    try:
+        import struct
+        import zipfile
+        with zipfile.ZipFile(path) as z:
+            infos = [i for i in z.infolist()
+                     if i.filename != "__meta__.npy"] or z.infolist()
+            info = infos[-1]
+        with open(path, "rb") as f:
+            f.seek(info.header_offset + 26)
+            fnlen, exlen = struct.unpack("<HH", f.read(4))
+        start = info.header_offset + 30 + fnlen + exlen
+        return start, max(int(info.compress_size), 1)
+    except Exception:  # noqa: BLE001 - non-zip target: damage anywhere
+        return 0, max(os.path.getsize(path), 1)
+
+
+def corrupt_file(path: str, spec: FaultSpec) -> None:
+    """Apply a write-corruption kind to a file on disk, deterministically:
+    ``truncate`` cuts the file mid-payload at the ``arg`` fraction of
+    the last member's data region; ``bit_flip`` XORs one bit at that
+    offset — inside an array payload, exactly the damage the container
+    checksums (and the zip member CRCs) must catch."""
+    start, size = _payload_window(path)
+    frac = float(spec.arg if spec.arg is not None else 0.5)
+    off = start + min(max(int(size * frac), 0), size - 1)
+    if spec.kind == "truncate":
+        with open(path, "rb+") as f:
+            f.truncate(max(off, 1))
+    elif spec.kind == "bit_flip":
+        with open(path, "rb+") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0x40]))
+    else:
+        raise ValueError(f"corrupt_file cannot apply kind {spec.kind!r}")

@@ -18,6 +18,15 @@ the last N snapshots of a sequence (e.g. the parsed lines of a
 `MetricRegistry.export_jsonl` file) — the rule must hold in EVERY
 snapshot of the window; a single snapshot is a window of one.
 
+An absent metric is a violation by default (an SLO over a signal that
+never materialized must fail loudly, not vacuously pass).
+``"if_present": true`` opts a rule out of that: it gates the metric
+only when it exists, for rule files shared across runs where the gated
+subsystem is legitimately optional (e.g. one soak rule file covering
+both lookahead and vocab-maintenance scenarios — the two compose
+mutually exclusively, so ``lookahead/compiles`` is absent from half
+the runs by design, not by failure).
+
 Violations come back in `analysis.passes.Finding` shape — the same
 typed finding `bench.py` and CI already gate audit results through —
 with stable content-derived ids (``slo:<name>``), so an SLO breach and
@@ -63,6 +72,9 @@ def validate_rule(rule: dict) -> dict:
         raise ValueError(
             f"SLO rule {rule['name']!r}: severity {sev!r} not in "
             "('error', 'warning')")
+    if not isinstance(rule.get("if_present", False), bool):
+        raise ValueError(
+            f"SLO rule {rule['name']!r}: if_present must be a bool")
     return rule
 
 
@@ -114,7 +126,8 @@ def evaluate_rules(rules: Sequence[dict],
     first); each rule reads its last ``window`` snapshots and must hold
     in all of them. A metric missing from any windowed snapshot is a
     violation — an SLO over a signal that never materialized must fail
-    loudly, not vacuously pass.
+    loudly, not vacuously pass — unless the rule opts out with
+    ``"if_present": true`` (see module docstring).
     """
     if isinstance(snapshots, dict):
         snapshots = [snapshots]
@@ -126,11 +139,18 @@ def evaluate_rules(rules: Sequence[dict],
         rule = validate_rule(dict(rule))
         window = snapshots[-int(rule.get("window", 1)):]
         op = _OPS[rule["op"]]
+        optional = bool(rule.get("if_present", False))
         worst: Optional[float] = None
         missing = False
         for snap in window:
             v = metric_value(snap, rule["metric"])
             if v is None:
+                if optional:
+                    # if_present: absent snapshots are skipped, but the
+                    # rule still gates every snapshot where the metric
+                    # DID materialize — a breach observed before the
+                    # subsystem went quiet must not be silenced
+                    continue
                 missing = True
                 break
             if not op(v, rule["threshold"]) and (

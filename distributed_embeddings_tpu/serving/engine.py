@@ -32,7 +32,7 @@ docs/serving.md "Weight streaming" for the contract).
 """
 
 import math
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 import jax
@@ -132,6 +132,11 @@ class InferenceEngine:
                 "binding's physical rows are plan-specific")
         self.vocab = vocab_manager
         self._vocab_loaded_path = None
+        # degradation accounting (ISSUE 13): reasons currently active —
+        # mirrored into the `serve/degraded{reason=}` gauge family (1
+        # while active, reset to 0 when the reason clears)
+        self._degraded_active: frozenset = frozenset()
+        self.last_poll_error: Optional[str] = None
 
         emb = self.embedding
         self.caches: Dict[int, HotRowCache] = {}
@@ -408,14 +413,51 @@ class InferenceEngine:
         """Apply every new stream file a training job has published into
         `publish_dir` (chain order; snapshot fallback), patching caches
         per file. Returns the applied infos; `update_stats(publish_dir)`
-        exposes the consumer's staleness accounting."""
+        exposes the consumer's staleness accounting.
+
+        NEVER raises on consumer-side faults (ISSUE 13): corrupt files
+        quarantine inside `DeltaConsumer.poll`; anything that still
+        escapes (injected poll errors, sidecar damage, cache-patch
+        failures) is caught here — the engine keeps serving the
+        last-good version, the failure lands in
+        ``serve/poll_errors_total`` + `last_poll_error`, and the active
+        degradation reasons are mirrored into the
+        ``serve/degraded{reason=}`` gauges (set to 1 while active, reset
+        to 0 when the reason clears) while staleness accounting keeps
+        running. Reasons: ``poll_error`` (the poll itself failed),
+        ``corrupt_stream`` / ``io_transient`` (from the consumer),
+        ``vocab_sidecar`` (binding sidecar unreadable), ``cache_patch``
+        (HBM cache patch failed; the cache was refreshed from the store
+        instead)."""
         consumer = self._consumers.get(publish_dir)
         if consumer is None:
             consumer = DeltaConsumer(self.store, publish_dir)
             self._consumers[publish_dir] = consumer
-        infos = consumer.poll()
-        for info in infos:
-            self._absorb_apply(info)
+        reasons = set()
+        infos: List[dict] = []
+        try:
+            infos = consumer.poll()
+            for info in infos:
+                if "cache_patch" in reasons:
+                    break            # full refresh below covers the rest
+                try:
+                    self._absorb_apply(info)
+                except Exception as e:  # noqa: BLE001 - degrade, never crash
+                    self._note_poll_error(e)
+                    reasons.add("cache_patch")
+            if "cache_patch" in reasons:
+                # tables already moved (consumer.poll applied every
+                # file) but a cache patch failed: re-read every
+                # resident row through the store ONCE, after the loop,
+                # so cached serving cannot hold pre-apply bytes —
+                # per-file refreshes would be N full refreshes for one
+                # correct end state
+                self._sync_store_params()
+                for cache in self.caches.values():
+                    cache.refresh_from(self.store)
+        except Exception as e:  # noqa: BLE001 - serve last-good instead
+            self._note_poll_error(e)
+            reasons.add("poll_error")
         if self.vocab is not None:
             # rebinds ride the same publication: load the newest binding
             # sidecar at-or-below the consumed version. NOT gated on new
@@ -425,11 +467,37 @@ class InferenceEngine:
             # still pick the matching binding up on its NEXT poll, not
             # only when more rows happen to arrive.
             from distributed_embeddings_tpu.vocab import latest_vocab_state
-            path = latest_vocab_state(publish_dir, upto=self.store.version)
-            if path is not None and path != self._vocab_loaded_path:
-                self.vocab.load_state(path)
-                self._vocab_loaded_path = path
+            try:
+                path = latest_vocab_state(publish_dir,
+                                          upto=self.store.version)
+                if path is not None and path != self._vocab_loaded_path:
+                    self.vocab.load_state(path)
+                    self._vocab_loaded_path = path
+            except Exception as e:  # noqa: BLE001 - keep previous binding
+                # a corrupt/unreadable sidecar must not take serving
+                # down: the previous binding keeps translating —
+                # documented staleness (keys rebound at the damaged
+                # version translate per the older binding) until the
+                # next publish's sidecar supersedes it
+                self._note_poll_error(e)
+                reasons.add("vocab_sidecar")
+        reasons |= consumer.degraded_reasons()
+        for r in reasons:
+            self._metrics.gauge("serve/degraded", reason=r).set(1)
+        for r in self._degraded_active - reasons:
+            self._metrics.gauge("serve/degraded", reason=r).set(0)
+        self._degraded_active = frozenset(reasons)
         return infos
+
+    def _note_poll_error(self, e: BaseException) -> None:
+        self.last_poll_error = f"{type(e).__name__}: {e}"[:300]
+        self._metrics.counter("serve/poll_errors_total").inc()
+
+    def degraded_reasons(self) -> frozenset:
+        """The reasons currently holding this engine in degraded mode
+        (empty = healthy; mirrors the ``serve/degraded{reason=}``
+        gauges)."""
+        return self._degraded_active
 
     def update_stats(self, publish_dir: str) -> dict:
         consumer = self._consumers.get(publish_dir)

@@ -61,18 +61,45 @@ process, same file) like any other SPMD param update.
 import os
 import re
 import time
+import warnings
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from distributed_embeddings_tpu import faults
 from distributed_embeddings_tpu.ops import sparse_update as sparse_update_ops
 from distributed_embeddings_tpu.utils import checkpoint as ckpt_lib
 
 __all__ = ["DeltaChainError", "DeltaConsumer", "TableStore",
            "padded_gather_rows", "padded_scatter_rows",
            "restore_from_published", "scan_published"]
+
+
+# ------------------------------------------------- failure classification
+# (ISSUE 13) Two failure classes a consumer must tell apart:
+#   * TRANSIENT — the read may succeed if retried (filesystem flake,
+#     injected `InjectedIOError`): capped-exponential-backoff retry, give
+#     the file up for THIS poll if retries exhaust (the next poll tries
+#     again — serving latency must not absorb unbounded sleeps).
+#   * CORRUPT — the file's bytes are wrong and, streams being immutable
+#     once renamed into place, will stay wrong forever: quarantine (skip
+#     permanently + `store/corrupt_files_total` + one loud warning) and
+#     let the chain re-anchor on the next snapshot. The load layer
+#     (`checkpoint.load_row_delta*`) funnels every parse-level failure
+#     — bad zip, member CRC, torn payload, unparseable header — into
+#     `StreamIntegrityError`, so corruption is exactly ONE type here.
+# Anything else is a programming/config error and propagates (a
+# shape-signature mismatch or a hot-resident guard must fail loudly,
+# not quarantine a healthy stream; the serving engine's `poll_updates`
+# still converts it to degraded mode).
+def _is_transient_error(e: BaseException) -> bool:
+    return isinstance(e, OSError)
+
+
+def _is_corrupt_error(e: BaseException) -> bool:
+    return isinstance(e, ckpt_lib.StreamIntegrityError)
 
 
 class DeltaChainError(RuntimeError):
@@ -186,7 +213,9 @@ def _publish_path(directory: str, version: int, kind: str) -> str:
 
 def scan_published(directory: str) -> List[Tuple[int, str, str]]:
     """Sorted [(version, kind, path)] of the publish stream in a
-    directory (the delta log a consumer polls)."""
+    directory (the delta log a consumer polls). The ``store.scan``
+    fault point filters the result (delayed-visibility injection: a
+    lagging directory view hides fresh files for N scans)."""
     if not os.path.isdir(directory):
         return []
     out = []
@@ -195,7 +224,7 @@ def scan_published(directory: str) -> List[Tuple[int, str, str]]:
         if m:
             out.append((int(m.group(1)), m.group(2),
                         os.path.join(directory, name)))
-    return sorted(out)
+    return faults.filter_scan("store.scan", sorted(out))
 
 
 class TableStore:
@@ -267,6 +296,9 @@ class TableStore:
         # one publish later a delta's base_version could alias the
         # replaced state and chain onto unrelated tables silently.
         self._chain_broken = False
+        # directories whose orphaned tmp files this publisher already
+        # swept (once per directory per store — publisher startup)
+        self._swept_dirs: set = set()
 
     # ------------------------------------------------------------- state
     def use_registry(self, registry) -> None:
@@ -452,6 +484,21 @@ class TableStore:
         publish, plus the dp tables whole. Requires a commit since the
         last publish (versions must be distinct per file).
 
+        Robustness (ISSUE 13): the first publish into a directory sweeps
+        orphaned ``*.tmp*`` files a crashed predecessor left; the stream
+        file is fsync'd before — and its directory after — the atomic
+        rename (rename is atomic against concurrent readers but not
+        against power loss); and all publisher state (`_publishes`,
+        `_published_version`, the pending touched keys) moves ONLY after
+        the rename lands, so an injected `InjectedCrash` (or a real
+        exception) between write and rename leaves the publisher able to
+        retry the same content under a later version. The
+        ``store.publish`` fault point wraps the write: ``pause`` skips
+        the publish (returns ``{"kind": "paused", ...}``, state kept),
+        ``truncate``/``bit_flip`` corrupt the renamed-in file (the
+        consumer's quarantine path owns those), ``crash_before_rename``
+        raises after writing the tmp file.
+
         Returns {"kind", "version", "base_version", "path", "bytes",
         "rows"}."""
         self._require_single_controller("publish")
@@ -460,10 +507,21 @@ class TableStore:
                 "publish: nothing committed since the last publish "
                 "(stream files are keyed by version)")
         os.makedirs(directory, exist_ok=True)
-        self._publishes += 1
+        m = self._metrics
+        if directory not in self._swept_dirs:
+            self._swept_dirs.add(directory)
+            removed = ckpt_lib.sweep_orphan_tmp(directory)
+            if removed:
+                m.counter("store/orphan_tmp_swept_total").inc(len(removed))
+                warnings.warn(
+                    f"publish: swept {len(removed)} orphaned tmp file(s) "
+                    f"from {directory} (crashed publisher leftovers): "
+                    f"{[os.path.basename(p) for p in removed]}",
+                    RuntimeWarning, stacklevel=2)
+        publishes = self._publishes + 1
         snap = (force_snapshot or self._published_version is None
                 or (self.snapshot_every
-                    and self._publishes % self.snapshot_every == 0))
+                    and publishes % self.snapshot_every == 0))
         meta = {"version": self.version,
                 "base_version": self._published_version,
                 "published_at": time.time(),
@@ -489,17 +547,34 @@ class TableStore:
                 arrays[f"dp{j}_full"] = dp
                 n_rows += dp.shape[0]
         path = _publish_path(directory, self.version, meta["kind"])
+        spec = faults.check("store.publish", path=path,
+                            stream_kind=meta["kind"])
+        if spec is not None:
+            m.counter("store/publish_faults_total", kind=spec.kind).inc()
+        if spec is not None and spec.kind == "pause":
+            # publisher pause: nothing written, nothing advanced — the
+            # pending touched keys ride into the next (resumed) publish
+            return {"kind": "paused", "version": self.version,
+                    "base_version": meta["base_version"], "path": None,
+                    "bytes": 0, "rows": 0}
         # atomic publication: a concurrent consumer's directory scan must
         # never see a half-written file (the tmp name does not match the
-        # stream pattern, and os.replace is atomic on one filesystem)
+        # stream pattern, and os.replace is atomic on one filesystem);
+        # fsync file-then-rename-then-directory makes it crash-durable
         tmp = ckpt_lib.save_row_delta(path + ".tmp", meta, arrays)
-        os.replace(tmp, path)
+        if spec is not None and spec.kind in faults.CORRUPTING_KINDS:
+            faults.corrupt_file(tmp, spec)
+        if spec is not None and spec.kind == "crash_before_rename":
+            raise faults.InjectedCrash(
+                f"publish {path}: injected crash before rename "
+                f"(orphaned {os.path.basename(tmp)})")
+        ckpt_lib.publish_atomic(tmp, path)
+        self._publishes = publishes
         self._published_version = self.version
         self._pending = {}
         info = {"kind": meta["kind"], "version": self.version,
                 "base_version": meta["base_version"], "path": path,
                 "bytes": os.path.getsize(path), "rows": n_rows}
-        m = self._metrics
         m.counter("store/publishes").inc()
         m.counter("store/publish_bytes").inc(info["bytes"])
         m.counter("store/publish_rows").inc(n_rows)
@@ -554,6 +629,10 @@ class TableStore:
         ("tp", b) -> (keys, rows) for delta files so callers (the
         serving engine) can update HBM caches straight off the wire."""
         meta, arrays = ckpt_lib.load_row_delta(path)
+        if "crc" not in meta:
+            # checksum-less legacy (container v1) file: applied, but
+            # counted — the rolling-upgrade signal (ISSUE 13)
+            self._metrics.counter("store/legacy_files_total").inc()
         self._check_sig(meta, path)
         payload: Dict[Tuple[str, int], Tuple[np.ndarray, np.ndarray]] = {}
         if meta["kind"] == "snapshot":
@@ -624,33 +703,187 @@ class DeltaConsumer:
     """Poll loop + staleness accounting over one store and one publish
     directory: apply every new stream file in chain order, falling back
     to the newest snapshot when the chain breaks (missed or compacted
-    deltas)."""
+    deltas).
 
-    def __init__(self, store: TableStore, directory: str):
+    Hardened (ISSUE 13): a corrupt file (failed checksum, bad zip, torn
+    payload) is QUARANTINED — skipped permanently, counted in
+    ``store/corrupt_files_total``, one loud warning — and the chain
+    re-anchors on the publisher's next snapshot through the existing
+    snapshot-fallback path; a transient read error (`OSError`) retries
+    with capped exponential backoff (``store/poll_retries_total``) and,
+    if it persists, gives the file up for THIS poll only. `poll` leaves
+    the store in a consistent last-good state on every path — the
+    serving engine's `poll_updates` wraps it so nothing escapes to the
+    request loop. The metadata cache is bounded by the LIVE stream:
+    entries whose files left the directory (compaction, operator
+    cleanup) evict at the end of each poll.
+
+    Args:
+      store: consumer-side `TableStore`.
+      directory: publish directory to poll.
+      max_transient_retries: in-poll retry budget per file for transient
+        read errors (backoff 2^k * `retry_backoff_s`, capped at
+        `retry_backoff_cap_s` — bounded so a poll can never stall the
+        serving loop for more than ~0.1 s).
+    """
+
+    def __init__(self, store: TableStore, directory: str,
+                 max_transient_retries: int = 3,
+                 retry_backoff_s: float = 0.005,
+                 retry_backoff_cap_s: float = 0.05):
         self.store = store
         self.directory = directory
+        self.max_transient_retries = int(max_transient_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_backoff_cap_s = float(retry_backoff_cap_s)
         self._meta_cache: Dict[str, dict] = {}
         self.applied: List[dict] = []
         self._lag_versions: List[int] = []
         self._lag_seconds: List[float] = []
         self._apply_seconds = 0.0
         self._rows_applied = 0
+        # path -> reason string; quarantined files are invisible to the
+        # chooser forever (stream files are immutable once renamed, so
+        # corruption is permanent)
+        self.quarantined: Dict[str, str] = {}
+        self._retries_total = 0
+        self._degraded: set = set()
+        self._last_scan: List[Tuple[int, str, str]] = []
 
-    def _meta(self, path: str) -> dict:
+    # ------------------------------------------------------------ internals
+    def _visible(self) -> List[Tuple[int, str, str]]:
+        self._last_scan = scan_published(self.directory)
+        return [f for f in self._last_scan if f[2] not in self.quarantined]
+
+    def _quarantine(self, path: str, err: BaseException) -> None:
+        reason = f"{type(err).__name__}: {err}"
+        self.quarantined[path] = reason[:300]
+        self._degraded.add("corrupt_stream")
+        self.store._metrics.counter("store/corrupt_files_total").inc()
+        warnings.warn(
+            f"stream file quarantined (corrupt, will re-anchor on the "
+            f"next snapshot): {path}: {reason[:200]}",
+            RuntimeWarning, stacklevel=3)
+
+    def _backoff(self, attempt: int) -> None:
+        self._retries_total += 1
+        self.store._metrics.counter("store/poll_retries_total").inc()
+        time.sleep(min(self.retry_backoff_s * (2 ** attempt),
+                       self.retry_backoff_cap_s))
+
+    def _meta(self, path: str) -> Optional[dict]:
         """Cached metadata-header read (stream files are immutable once
-        renamed into place, so a path's header never changes)."""
+        renamed into place, so a path's header never changes). Returns
+        None when the header cannot be read this poll — corrupt headers
+        quarantine the file, transient errors leave it for the next
+        poll."""
         meta = self._meta_cache.get(path)
-        if meta is None:
-            meta = ckpt_lib.load_row_delta_meta(path)
-            self._meta_cache[path] = meta
-        return meta
+        if meta is not None:
+            return meta
+        for attempt in range(self.max_transient_retries + 1):
+            try:
+                meta = ckpt_lib.load_row_delta_meta(path)
+                self._meta_cache[path] = meta
+                return meta
+            except Exception as e:  # noqa: BLE001 - classified below
+                if _is_transient_error(e):
+                    if attempt >= self.max_transient_retries:
+                        self._degraded.add("io_transient")
+                        return None
+                    self._backoff(attempt)
+                    continue
+                if _is_corrupt_error(e):
+                    self._quarantine(path, e)
+                    return None
+                raise
+
+    def _choose(self, files: List[Tuple[int, str, str]]) -> Optional[str]:
+        """The next applicable stream file, or None (caught up / waiting
+        on the publisher's next compaction)."""
+        if self.store._chain_broken:
+            # out-of-band replace: the local version bump is
+            # meaningless against the publisher's namespace, so no
+            # version filter and no delta qualifies — re-anchor on
+            # the NEWEST snapshot (even one consumed before the
+            # replace: re-applying re-syncs, then deltas replay)
+            snaps = [f for f in files if f[1] == "snapshot"]
+            return snaps[-1][2] if snaps else None
+        cand = [f for f in files if f[0] > self.store.version]
+        # prefer the delta that chains from the current version (the
+        # cheap path); otherwise the oldest newer snapshot — the chain
+        # replays from there on later iterations. Neither found = chain
+        # gap with no snapshot yet: wait for the next compaction.
+        nxt = None
+        for version, kind, path in cand:
+            if kind == "delta":
+                meta = self._meta(path)
+                if meta is not None \
+                        and meta["base_version"] == self.store.version:
+                    return path
+            elif nxt is None:
+                nxt = path                   # snapshot: applies from any v
+        return nxt
+
+    def _apply_one(self, path: str) -> Tuple[Optional[dict], str]:
+        """Apply one file with transient retry; returns (info, status)
+        with status in {"applied", "transient", "quarantined"}."""
+        for attempt in range(self.max_transient_retries + 1):
+            t0 = time.perf_counter()
+            try:
+                info = self.store.apply_published(path)
+            except DeltaChainError:
+                raise            # chooser contract violation: loud
+            except Exception as e:  # noqa: BLE001 - classified below
+                if _is_transient_error(e):
+                    if attempt >= self.max_transient_retries:
+                        self._degraded.add("io_transient")
+                        return None, "transient"
+                    self._backoff(attempt)
+                    continue
+                if _is_corrupt_error(e):
+                    self._quarantine(path, e)
+                    return None, "quarantined"
+                raise
+            self._apply_seconds += time.perf_counter() - t0
+            return info, "applied"
+        return None, "transient"             # unreachable; keeps mypy honest
+
+    def _evict_meta_cache(self) -> None:
+        """Bound the metadata cache by the LIVE stream (ISSUE 13
+        satellite): a long-running consumer's cache otherwise grows with
+        run length as compaction deletes superseded deltas. Uses the
+        poll's own final scan — no extra directory walk."""
+        live = {path for _, _, path in self._last_scan}
+        if any(p not in live for p in self._meta_cache):
+            self._meta_cache = {p: m for p, m in self._meta_cache.items()
+                                if p in live}
+        for p in [p for p in self.quarantined if p not in live]:
+            del self.quarantined[p]          # counted already; file gone
+
+    def degraded_reasons(self) -> frozenset:
+        """The consumer's current degradation set (empty = healthy):
+        ``corrupt_stream`` while quarantined damage keeps it behind the
+        publisher, ``io_transient`` while reads flake. Cleared when a
+        poll ends fully caught up."""
+        return frozenset(self._degraded)
 
     def poll(self) -> List[dict]:
         """Apply every applicable published file. Returns the applied
-        infos (possibly empty)."""
-        files = scan_published(self.directory)
+        infos (possibly empty). Never raises on corrupt or transiently
+        unreadable stream files (see class docstring); the
+        ``consumer.poll`` fault point can inject a transient error at
+        entry (exercising the engine-level degradation path)."""
+        faults.check_raise("consumer.poll", directory=self.directory)
+        files = self._visible()
         newer = [f for f in files if f[0] > self.store.version]
         if not newer and not self.store._chain_broken:
+            self._evict_meta_cache()
+            # healthy only if nothing newer exists even among the
+            # quarantined files (a quarantined NEWER file means serving
+            # is genuinely behind the publisher: stay degraded until
+            # the re-anchoring snapshot arrives)
+            if not any(f[0] > self.store.version for f in self._last_scan):
+                self._degraded.clear()
             return []
         if newer:
             # staleness just before this poll: how many published
@@ -661,42 +894,17 @@ class DeltaConsumer:
         out = []
         latest_seen = self.store.version
         while True:
-            files = scan_published(self.directory)
-            if files:
-                latest_seen = max(latest_seen, files[-1][0])
-            if self.store._chain_broken:
-                # out-of-band replace: the local version bump is
-                # meaningless against the publisher's namespace, so no
-                # version filter and no delta qualifies — re-anchor on
-                # the NEWEST snapshot (even one consumed before the
-                # replace: re-applying re-syncs, then deltas replay)
-                snaps = [f for f in files if f[1] == "snapshot"]
-                if not snaps:
-                    break                    # wait for the next compaction
-                nxt = snaps[-1][2]
-            else:
-                files = [f for f in files if f[0] > self.store.version]
-                if not files:
-                    break
-                # prefer the delta that chains from the current version
-                # (the cheap path); otherwise the oldest newer snapshot
-                # — the chain replays from there on later iterations.
-                # Neither found = chain gap with no snapshot yet: wait
-                # for the publisher's next compaction.
-                nxt = None
-                for version, kind, path in files:
-                    if kind == "delta":
-                        if self._meta(path)["base_version"] \
-                                == self.store.version:
-                            nxt = path
-                            break
-                    elif nxt is None:
-                        nxt = path           # snapshot: applies from any v
-                if nxt is None:
-                    break
-            t0 = time.perf_counter()
-            info = self.store.apply_published(nxt)
-            self._apply_seconds += time.perf_counter() - t0
+            files = self._visible()
+            if self._last_scan:
+                latest_seen = max(latest_seen, self._last_scan[-1][0])
+            nxt = self._choose(files)
+            if nxt is None:
+                break
+            info, status = self._apply_one(nxt)
+            if status == "quarantined":
+                continue                     # rescan: snapshot fallback
+            if status != "applied":
+                break                        # transient: next poll retries
             self._rows_applied += info["rows"]
             if info.get("published_at"):
                 self._lag_seconds.append(
@@ -706,13 +914,16 @@ class DeltaConsumer:
                         self._lag_seconds[-1])
             self.applied.append(info)
             out.append(info)
-        if out:
-            # post-poll residual lag (0 when fully caught up; >0 when the
-            # chain still waits on the publisher's next compaction) —
-            # from the apply loop's own final scan, no extra directory
-            # walk on the serving hot path
-            self.store._metrics.gauge("store/version_lag").set(
-                max(0, latest_seen - self.store.version))
+        # post-poll residual lag (0 when fully caught up; >0 when the
+        # chain still waits on the publisher's next compaction) — from
+        # the apply loop's own final scan, no extra directory walk on
+        # the serving hot path
+        residual = max(0, latest_seen - self.store.version)
+        if out or residual:
+            self.store._metrics.gauge("store/version_lag").set(residual)
+        if residual == 0 and not self.store._chain_broken:
+            self._degraded.clear()           # caught up: healed
+        self._evict_meta_cache()
         return out
 
     def stats(self) -> dict:
@@ -745,6 +956,9 @@ class DeltaConsumer:
             "version_monotonic": versions == sorted(versions)
             and len(set(versions)) == len(versions),
             "version": self.store.version,
+            "quarantined_files": len(self.quarantined),
+            "poll_retries": self._retries_total,
+            "degraded_reasons": sorted(self._degraded),
         }
 
 

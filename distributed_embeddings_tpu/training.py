@@ -623,22 +623,38 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
         store.commit(params["embedding"], opt_state["emb"],
                      touched=(vocab.drain_touched()
                               if vocab is not None else None))
-        if vocab is not None:
-            # binding sidecar for the version about to publish — written
-            # BEFORE the stream file, so any consumer that can see the
-            # rows can also see the matching key->row map (the reverse
-            # order would open a window where a poll applies version V's
-            # rows but only finds the V-1 binding)
-            from distributed_embeddings_tpu.vocab import vocab_state_path
-            import os as _os
-            _os.makedirs(publish_dir, exist_ok=True)
-            # full=False: the publish sidecar is the serving-grade
-            # binding (keys + free list), NOT the trainer's counters
-            # and stash — those are checkpoint state and would make
-            # every sidecar table-sized under sustained drift
-            vocab.save_state(vocab_state_path(publish_dir, store.version),
-                             full=False)
-        history.setdefault("published", []).append(store.publish(publish_dir))
+        from distributed_embeddings_tpu import faults
+        try:
+            if vocab is not None:
+                # binding sidecar for the version about to publish —
+                # written BEFORE the stream file, so any consumer that
+                # can see the rows can also see the matching key->row
+                # map (the reverse order would open a window where a
+                # poll applies version V's rows but only finds the V-1
+                # binding)
+                from distributed_embeddings_tpu.vocab import (
+                    vocab_state_path)
+                import os as _os
+                _os.makedirs(publish_dir, exist_ok=True)
+                # full=False: the publish sidecar is the serving-grade
+                # binding (keys + free list), NOT the trainer's counters
+                # and stash — those are checkpoint state and would make
+                # every sidecar table-sized under sustained drift
+                vocab.save_state(
+                    vocab_state_path(publish_dir, store.version),
+                    full=False)
+            history.setdefault("published", []).append(
+                store.publish(publish_dir))
+        except faults.InjectedCrash as e:
+            # simulated publisher crash+restart (ISSUE 13): the tmp file
+            # is orphaned on disk (the restarted publisher's first
+            # publish sweeps it), nothing was renamed into the stream,
+            # and the store's pending touched keys survive — the next
+            # cadence republishes them under a later version, so no
+            # consumer ever misses a row. ONLY the injected type is
+            # caught; real publish failures still propagate.
+            reg.counter("store/publish_crashes_total").inc()
+            history.setdefault("publish_crashes", []).append(str(e)[:200])
 
     def pull(s):
         b = get_batch(s) if get_batch else next(it)

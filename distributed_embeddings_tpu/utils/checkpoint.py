@@ -37,10 +37,14 @@ optimizer-state rows) back into the canonical tables.
 
 import json
 import os
+import warnings
+import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
+
+from distributed_embeddings_tpu import faults
 
 __all__ = [
     "save_checkpoint",
@@ -51,7 +55,129 @@ __all__ = [
     "save_row_delta",
     "load_row_delta",
     "load_row_delta_meta",
+    "StreamIntegrityError",
+    "verify_stream_payload",
+    "legacy_load_count",
+    "publish_atomic",
+    "sweep_orphan_tmp",
+    "STREAM_CONTAINER_VERSION",
 ]
+
+# ---------------------------------------------------------------- container
+# Stream-file container version (ISSUE 13). v2 adds integrity checksums:
+# a per-array crc32 table plus a crc over the canonicalized metadata
+# header itself, both verified on load. v1 (checksum-less) files still
+# load — with one loud process-wide warning and a counter — so streams
+# published by older builds survive a rolling upgrade.
+STREAM_CONTAINER_VERSION = 2
+
+
+class StreamIntegrityError(ValueError):
+    """A stream file's payload or metadata header fails its checksum —
+    the file is corrupt (torn write, bit rot, truncation that the zip
+    layer happened not to catch) and must be quarantined, never
+    applied."""
+
+
+_legacy_loads = 0
+_legacy_warned = False
+
+
+def legacy_load_count() -> int:
+    """Process-wide count of checksum-less (container v1) stream files
+    loaded — the rolling-upgrade signal a fleet watches to know when
+    every publisher writes v2 and legacy tolerance can be dropped."""
+    return _legacy_loads
+
+
+def _note_legacy(path: str) -> None:
+    global _legacy_loads, _legacy_warned
+    _legacy_loads += 1
+    if not _legacy_warned:
+        _legacy_warned = True
+        warnings.warn(
+            f"{path}: checksum-less legacy stream file (container v1) — "
+            "loaded WITHOUT integrity verification. One warning per "
+            "process; count via checkpoint.legacy_load_count().",
+            RuntimeWarning, stacklevel=3)
+
+
+def _array_crc(arr) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _header_crc(meta: dict) -> int:
+    clean = {k: meta[k] for k in meta if k != "header_crc"}
+    return zlib.crc32(
+        json.dumps(clean, sort_keys=True).encode()) & 0xFFFFFFFF
+
+
+def verify_stream_payload(meta: dict, arrays: Dict[str, np.ndarray],
+                          path: str = "<stream>") -> bool:
+    """Verify a loaded stream file against its embedded checksums.
+    Returns True when verified, False for legacy (v1) files (counted +
+    warned once); raises `StreamIntegrityError` on any mismatch."""
+    if "crc" not in meta:
+        _note_legacy(path)
+        return False
+    if "header_crc" in meta and _header_crc(meta) != int(meta["header_crc"]):
+        raise StreamIntegrityError(
+            f"{path}: metadata header checksum mismatch")
+    crc = meta["crc"]
+    bad = [n for n in arrays
+           if n not in crc or _array_crc(arrays[n]) != int(crc[n])]
+    missing = [n for n in crc if n not in arrays]
+    if bad or missing:
+        raise StreamIntegrityError(
+            f"{path}: payload checksum failure "
+            f"(mismatched={bad}, missing={missing})")
+    return True
+
+
+# ------------------------------------------------------------- durability
+def _fsync_fd_of(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def publish_atomic(tmp: str, final: str) -> str:
+    """Durable atomic publication: fsync the written tmp file BEFORE the
+    rename (so the rename can never point at data the kernel has not
+    persisted) and fsync the directory AFTER (so the new name itself
+    survives power loss — `os.replace` is atomic against concurrent
+    readers but says nothing about durability). Directory fsync is
+    best-effort: some filesystems refuse it, and rename atomicity holds
+    regardless."""
+    _fsync_fd_of(tmp)
+    os.replace(tmp, final)
+    try:
+        _fsync_fd_of(os.path.dirname(os.path.abspath(final)) or ".")
+    except OSError:
+        pass
+    return final
+
+
+def sweep_orphan_tmp(directory: str) -> List[str]:
+    """Remove orphaned ``*.tmp*`` files a crashed publisher left behind
+    (write-then-rename means a tmp name on disk is by definition dead
+    state — no reader ever matches it, it only leaks bytes). Returns the
+    removed paths. Publishers call this once at startup; the directory
+    is single-publisher by contract (docs/serving.md)."""
+    removed: List[str] = []
+    if not os.path.isdir(directory):
+        return removed
+    for name in sorted(os.listdir(directory)):
+        if ".tmp" in name:
+            path = os.path.join(directory, name)
+            try:
+                os.remove(path)
+                removed.append(path)
+            except OSError:
+                continue
+    return removed
 
 
 def _checkpointer():
@@ -179,26 +305,84 @@ def save_row_delta(path: str, meta: dict, arrays: Dict[str, np.ndarray]
     "published_at", "sig"} — `version` is the publisher's monotonic
     store version, `base_version` the previous published version a
     delta chains from (None for snapshots/first publish), `sig` the
-    per-table (input_dim, output_dim) list consumers verify."""
+    per-table (input_dim, output_dim) list consumers verify.
+
+    Container v2 (ISSUE 13): the written header additionally carries
+    ``container`` (format version), ``crc`` (per-array crc32 over raw
+    bytes) and ``header_crc`` (crc32 of the canonicalized header minus
+    itself); `load_row_delta` verifies all three. The zip layer's own
+    per-member CRC catches most in-file damage at read time — this
+    layer exists for what it cannot: header/payload cross-consistency,
+    damage applied after extraction, and a versioned, self-describing
+    on-disk contract."""
     if not path.endswith(".npz"):
         path = path + ".npz"
+    meta = dict(meta)
+    meta["container"] = STREAM_CONTAINER_VERSION
+    meta["crc"] = {name: _array_crc(arr) for name, arr in arrays.items()}
+    meta["header_crc"] = _header_crc(meta)
     np.savez(path, __meta__=np.asarray(json.dumps(meta)), **arrays)
     return path
 
 
-def load_row_delta(path: str) -> Tuple[dict, Dict[str, np.ndarray]]:
-    """Read a weight-streaming file: (meta dict, {name: array})."""
-    data = np.load(path, allow_pickle=False)
-    meta = json.loads(str(data["__meta__"]))
-    return meta, {k: data[k] for k in data.files if k != "__meta__"}
+def load_row_delta(path: str, verify: bool = True
+                   ) -> Tuple[dict, Dict[str, np.ndarray]]:
+    """Read a weight-streaming file: (meta dict, {name: array}).
+
+    ``verify=True`` (default) checks the container-v2 checksums —
+    header crc and every array's crc32 — raising `StreamIntegrityError`
+    on mismatch (checksum-less legacy files load with a one-time
+    warning + `legacy_load_count`). Note verification materializes
+    every member; pass verify=False only for trusted local tooling.
+    Any parse-level damage (bad zip structure, member CRC failure,
+    torn/truncated payload, unparseable header) re-raises as
+    `StreamIntegrityError` — the ONE type consumers classify as
+    corrupt, so errors raised by post-load logic (shape-signature
+    mismatch, guards) keep propagating as the config/programming
+    errors they are. `OSError` passes through untouched (the
+    transient class consumers retry).
+
+    The ``store.load`` fault point wraps this read (ISSUE 13)."""
+    faults.check_raise("store.load", path=path)
+    try:
+        data = np.load(path, allow_pickle=False)
+        meta = json.loads(str(data["__meta__"]))
+        # materializing every member here surfaces lazy zip CRC
+        # failures inside this classification boundary
+        arrays = {k: data[k] for k in data.files if k != "__meta__"}
+    except (OSError, StreamIntegrityError):
+        raise
+    except Exception as e:  # noqa: BLE001 - parse damage = corrupt file
+        raise StreamIntegrityError(
+            f"{path}: unreadable stream container "
+            f"({type(e).__name__}: {e})") from e
+    if verify:
+        verify_stream_payload(meta, arrays, path=path)
+    return meta, arrays
 
 
-def load_row_delta_meta(path: str) -> dict:
+def load_row_delta_meta(path: str, verify: bool = True) -> dict:
     """Read ONLY the metadata header of a weight-streaming file — npz
     members load lazily, so a consumer's chain check (which may scan many
-    candidate deltas per poll) never materializes row payloads."""
-    data = np.load(path, allow_pickle=False)
-    return json.loads(str(data["__meta__"]))
+    candidate deltas per poll) never materializes row payloads.
+    ``verify=True`` checks the header's own crc (not the arrays').
+    Parse-level damage re-raises as `StreamIntegrityError` exactly
+    like `load_row_delta` (see there)."""
+    faults.check_raise("store.load", path=path)
+    try:
+        data = np.load(path, allow_pickle=False)
+        meta = json.loads(str(data["__meta__"]))
+    except (OSError, StreamIntegrityError):
+        raise
+    except Exception as e:  # noqa: BLE001 - parse damage = corrupt file
+        raise StreamIntegrityError(
+            f"{path}: unreadable stream header "
+            f"({type(e).__name__}: {e})") from e
+    if verify and "header_crc" in meta \
+            and _header_crc(meta) != int(meta["header_crc"]):
+        raise StreamIntegrityError(
+            f"{path}: metadata header checksum mismatch")
+    return meta
 
 
 def load_global_weights(path: str, mmap: bool = True) -> List[np.ndarray]:

@@ -33,7 +33,14 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence, Tuple
 
+from distributed_embeddings_tpu import faults
 from distributed_embeddings_tpu.obs.registry import MetricRegistry
+
+# transient stage-body errors (OSError — real filesystem flakes and the
+# injected ``ingest.stage`` fault alike) retry in place this many times
+# before propagating through the normal drain-then-raise path; stage fns
+# are pure per-item transforms by contract, so a retry is safe
+_STAGE_RETRIES = 3
 
 __all__ = ["IngestPipeline", "SerialPipeline", "READ_STAGE"]
 
@@ -110,6 +117,7 @@ class IngestPipeline:
         self._stop = threading.Event()
         self._closed = False
         reg = registry if registry is not None else MetricRegistry()
+        self._registry = reg
         self._hists = {n: reg.histogram("ingest/stage_seconds", stage=n)
                        for n in names}
         # queues[0] feeds stage 0; queues[-1] feeds the consumer
@@ -162,6 +170,27 @@ class IngestPipeline:
             if not self._put(out, item):
                 return
 
+    def _run_stage_body(self, sname: str, fn: Callable, item):
+        """One stage application with bounded transient retry (ISSUE 13):
+        an `OSError` from the stage body — the ``ingest.stage`` fault
+        point injects exactly this class — retries in place up to
+        `_STAGE_RETRIES` times (tiny capped backoff, counted in
+        ``ingest/stage_retries_total{stage=}``) before propagating, so a
+        filesystem flake degrades to a latency blip instead of killing
+        the training run. Non-OSError exceptions propagate immediately
+        (the drain-then-raise contract is unchanged)."""
+        for attempt in range(_STAGE_RETRIES + 1):
+            try:
+                faults.check_raise("ingest.stage", stage=sname)
+                with _annotate(sname):
+                    return fn(item)
+            except OSError:
+                if attempt >= _STAGE_RETRIES:
+                    raise
+                self._registry.counter("ingest/stage_retries_total",
+                                       stage=sname).inc()
+                time.sleep(min(0.002 * (2 ** attempt), 0.02))
+
     def _stage_loop(self, idx: int, sname: str, fn: Callable):
         hist = self._hists[sname]
         inq, outq = self._queues[idx], self._queues[idx + 1]
@@ -175,8 +204,7 @@ class IngestPipeline:
                 return
             t0 = time.perf_counter()
             try:
-                with _annotate(sname):
-                    item = fn(item)
+                item = self._run_stage_body(sname, fn, item)
             except BaseException as e:  # noqa: BLE001 - propagate, never hang
                 self._put(outq, _Failure(e, sname))
                 return

@@ -816,12 +816,27 @@ class VocabManager:
         (the publish sidecar form `fit` writes) carries only what a
         translating consumer needs — key table + free list + policy
         header — so per-publish sidecar bytes scale with the BINDING,
-        not with a table-sized stash."""
+        not with a table-sized stash.
+
+        The write is crash-durable like `TableStore.publish` (ISSUE 13):
+        fsync file + directory around the atomic rename, and the
+        ``vocab.save_state`` fault point can corrupt the payload or
+        crash before the rename (consumers verify the container
+        checksums on load and keep serving the previous binding)."""
+        from distributed_embeddings_tpu import faults
+        from distributed_embeddings_tpu.utils.checkpoint import (
+            publish_atomic)
         meta, arrays = self.state_dict(full=full)
-        tmp = save_row_delta(path + ".tmp", meta, arrays)
         final = path if path.endswith(".npz") else path + ".npz"
-        os.replace(tmp, final)
-        return final
+        spec = faults.check("vocab.save_state", path=final)
+        tmp = save_row_delta(path + ".tmp", meta, arrays)
+        if spec is not None and spec.kind in faults.CORRUPTING_KINDS:
+            faults.corrupt_file(tmp, spec)
+        if spec is not None and spec.kind == "crash_before_rename":
+            raise faults.InjectedCrash(
+                f"save_state {final}: injected crash before rename "
+                f"(orphaned {os.path.basename(tmp)})")
+        return publish_atomic(tmp, final)
 
     def load_state(self, path: str) -> None:
         """Restore the full saved state — including the ADMISSION POLICY
