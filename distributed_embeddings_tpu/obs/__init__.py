@@ -1,5 +1,7 @@
-"""Unified runtime telemetry (ISSUE 11): one metric registry, host-side
-step-span tracing, and declarative SLO evaluation across
+"""Unified runtime telemetry (ISSUE 11/14): one metric registry,
+host-side step-span tracing, declarative SLO evaluation, a bounded
+flight recorder with version-lineage tracks, and device-time
+attribution from profiler captures — across
 train/serve/vocab/store/lookahead.
 
 See docs/observability.md for the full API and schema; the short form:
@@ -12,6 +14,8 @@ See docs/observability.md for the full API and schema; the short form:
         ...
     snap = reg.snapshot()
     findings = obs.evaluate_rules(obs.load_rules("slo.json"), snap)
+    obs.default_recorder().export("trace.json")   # Perfetto-loadable
+    obs.attribution.attribute_logdir(profiler_logdir, registry=reg)
 """
 
 from distributed_embeddings_tpu.obs.registry import (  # noqa: F401
@@ -23,6 +27,10 @@ from distributed_embeddings_tpu.obs.spans import (  # noqa: F401
     annotation, current_span, span)
 from distributed_embeddings_tpu.obs.instrument import (  # noqa: F401
     export_exchange_gauges, export_kernel_gauges)
+from distributed_embeddings_tpu.obs.trace import (  # noqa: F401
+    FlightRecorder, default_recorder, dump_postmortem,
+    reset_default_recorder)
+from distributed_embeddings_tpu.obs import attribution  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "LatencyHistogram", "MetricRegistry",
@@ -30,4 +38,6 @@ __all__ = [
     "span", "annotation", "current_span",
     "load_rules", "evaluate_rules", "metric_value", "summarize",
     "export_exchange_gauges", "export_kernel_gauges",
+    "FlightRecorder", "default_recorder", "reset_default_recorder",
+    "dump_postmortem", "attribution",
 ]

@@ -32,6 +32,7 @@ jit trace.
 """
 
 import json
+import os
 import re
 import threading
 import time
@@ -258,30 +259,52 @@ class MetricRegistry:
                            for n, kl, m in self._by_kind("histogram")},
         }
 
-    def export_jsonl(self, path: str, extra: Optional[dict] = None) -> dict:
+    def export_jsonl(self, path: str, extra: Optional[dict] = None,
+                     fsync: bool = False) -> dict:
         """Append one timestamped snapshot line to `path` (creating it);
         the soak-run export format: one JSON object per line, so a
         watcher can tail it and `obs.slo.evaluate_rules` can window
-        over the parsed lines. Returns the line's dict."""
+        over the parsed lines. Returns the line's dict.
+
+        The line is FLUSHED to the OS before the file closes — a
+        crashed soak must not lose the tail lines its SLO window
+        evaluates over (the postmortem reads the last written step).
+        ``fsync=True`` additionally fsyncs, for the final/explicit
+        export of a run (per-line fsync would put a disk barrier on the
+        snapshot cadence; per-line flush already survives a process
+        crash, and the closing export survives power loss)."""
         line = {"ts": round(time.time(), 3), **(extra or {}),
                 **self.snapshot()}
         with open(path, "a") as f:
             f.write(json.dumps(line) + "\n")
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
         return line
 
     def to_prometheus(self) -> str:
         """Prometheus text exposition of the registry: counters as
         ``*_total``, gauges verbatim, histograms as summaries
         (quantile series + ``_count``/``_sum``). Metric names sanitize
-        ``/`` and other non-identifier characters to ``_``."""
+        ``/`` and other non-identifier characters to ``_``; label
+        VALUES escape per the text-format spec (backslash, double
+        quote, newline) — degraded reasons and quarantine paths put
+        arbitrary filesystem strings into labels, and one unescaped
+        quote makes the whole exposition unparseable."""
         def sane(name: str) -> str:
             return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+        def esc(value: object) -> str:
+            # the exposition-format escape set, in spec order:
+            # backslash first (or the others' escapes double-escape)
+            return (str(value).replace("\\", "\\\\")
+                    .replace('"', '\\"').replace("\n", "\\n"))
 
         def fmt_labels(labels: dict, extra: Optional[dict] = None) -> str:
             merged = {**labels, **(extra or {})}
             if not merged:
                 return ""
-            inner = ",".join(f'{sane(str(k))}="{merged[k]}"'
+            inner = ",".join(f'{sane(str(k))}="{esc(merged[k])}"'
                              for k in sorted(merged))
             return "{" + inner + "}"
 

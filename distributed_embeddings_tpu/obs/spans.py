@@ -29,6 +29,12 @@ module-wide, so backends with no profiler configured pay one failed
 construction per process instead of one exception per region
 (`utils.pipeline` delegates here — its per-stage-invocation re-probe
 was measurable ingest overhead).
+
+Since ISSUE 14 a span has a THIRD output: its begin/end edges land in
+the process-wide flight recorder (`obs.trace.default_recorder`), so
+the last window of loop structure is exportable as a Perfetto-loadable
+timeline at any moment — including from a postmortem dump on a box
+where no profiler session ever ran.
 """
 
 import contextlib
@@ -38,6 +44,7 @@ from typing import Optional
 
 from distributed_embeddings_tpu.obs.registry import (MetricRegistry,
                                                      default_registry)
+from distributed_embeddings_tpu.obs.trace import default_recorder
 
 __all__ = ["span", "annotation", "current_span"]
 
@@ -90,6 +97,8 @@ def span(name: str, registry: Optional[MetricRegistry] = None):
         stack = _state.stack = []
     path = f"{stack[-1]}/{name}" if stack else name
     stack.append(path)
+    rec = default_recorder()
+    rec.begin(path)
     t0 = time.perf_counter()
     try:
         with annotation(path):
@@ -97,4 +106,5 @@ def span(name: str, registry: Optional[MetricRegistry] = None):
     finally:
         dt = time.perf_counter() - t0
         stack.pop()
+        rec.end(path)
         reg.histogram("span_seconds", span=path).record(dt)

@@ -32,6 +32,7 @@ docs/serving.md "Weight streaming" for the contract).
 """
 
 import math
+import os
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -40,6 +41,8 @@ import jax.numpy as jnp
 
 from distributed_embeddings_tpu.layers.dist_model_parallel import (
     DistributedEmbedding)
+from distributed_embeddings_tpu.obs import trace as obs_trace
+from distributed_embeddings_tpu.obs.spans import span as obs_span
 from distributed_embeddings_tpu.serving.cache import (HotRowCache,
                                                       cached_group_lookup)
 from distributed_embeddings_tpu.store import DeltaConsumer, TableStore
@@ -137,6 +140,13 @@ class InferenceEngine:
         # while active, reset to 0 when the reason clears)
         self._degraded_active: frozenset = frozenset()
         self.last_poll_error: Optional[str] = None
+        # postmortem artifacts written on degraded ENTRY (ISSUE 14;
+        # paths, newest last) — one per reason activation while
+        # DET_OBS_POSTMORTEM_DIR is set
+        self.postmortems: List[str] = []
+        # store version of the newest predict served (drives the
+        # lineage "serve" close: first predict at >= V ends V's track)
+        self._lineage_served_version = 0
 
         emb = self.embedding
         self.caches: Dict[int, HotRowCache] = {}
@@ -295,26 +305,45 @@ class InferenceEngine:
 
         Returns the forward output(s) sliced to the request's true batch
         size (model output array, or one array per embedding input).
+
+        The request runs inside a ``serve/predict`` span (ISSUE 14) so
+        serving device time attributes next to the trainer's
+        ``train/step`` phases in a profiler capture, and the request
+        edge lands on the flight recorder's timeline.
         """
         if self._model is None:
             numerical, cats = None, list(batch)
         else:
             numerical, cats = batch
             cats = list(cats)
-        if self.vocab is not None:
-            # raw keys -> physical rows, query-only (misses serve the
-            # fallback row; serving traffic never admits or counts)
-            cats = self.vocab.translate(cats)
-        prepped = self._normalize(cats)
-        b = prepped[0].ids.shape[0]
-        target = self._target_batch(b)
-        out = self._predict_padded(numerical, prepped, target, b)
+        with obs_span("serve/predict", self._metrics):
+            if self.vocab is not None:
+                # raw keys -> physical rows, query-only (misses serve
+                # the fallback row; serving traffic never admits or
+                # counts)
+                cats = self.vocab.translate(cats)
+            prepped = self._normalize(cats)
+            b = prepped[0].ids.shape[0]
+            target = self._target_batch(b)
+            out = self._predict_padded(numerical, prepped, target, b)
         self.n_predicts += 1
         self.rows_served += b
         self.rows_padded += target - b
         self._metrics.counter("serve/predicts").inc()
         self._metrics.counter("serve/rows_served").inc(b)
         self._metrics.counter("serve/rows_padded").inc(target - b)
+        if self.store.version > self._lineage_served_version:
+            # lineage (ISSUE 14): the FIRST predict answered at >= V
+            # closes version V's async track — commit -> publish ->
+            # scan -> apply -> served, end to end. A predict at V is
+            # also the first at >= every still-open version below it
+            # (versions applied in one burst), so all of them close.
+            v = self.store.version
+            self._lineage_served_version = v
+            rec = obs_trace.default_recorder()
+            for ov in rec.lineage_open_versions():
+                if ov <= v:
+                    rec.lineage(ov, "serve", served_at_version=v)
         return jax.tree.map(lambda a: a[:b], out)
 
     def warmup(self, batch_sizes: Sequence[int], example=None) -> List[int]:
@@ -486,7 +515,34 @@ class InferenceEngine:
             self._metrics.gauge("serve/degraded", reason=r).set(1)
         for r in self._degraded_active - reasons:
             self._metrics.gauge("serve/degraded", reason=r).set(0)
+        entered = frozenset(reasons) - self._degraded_active
         self._degraded_active = frozenset(reasons)
+        if entered:
+            # degraded ENTRY is the incident moment (ISSUE 14): mark it
+            # on the flight recorder, and — when an operator pointed
+            # DET_OBS_POSTMORTEM_DIR somewhere — dump the ring +
+            # registry snapshot as the postmortem artifact, once per
+            # newly-activated reason. Dump failures degrade silently
+            # into last_poll_error: the artifact must never take
+            # serving down with it.
+            rec = obs_trace.default_recorder()
+            for r in sorted(entered):
+                rec.instant("serve/degraded_entry", reason=r,
+                            error=self.last_poll_error)
+            pm_dir = os.environ.get("DET_OBS_POSTMORTEM_DIR")
+            if pm_dir:
+                for r in sorted(entered):
+                    try:
+                        self.postmortems.append(obs_trace.dump_postmortem(
+                            pm_dir, f"degraded:{r}",
+                            registry=self._metrics,
+                            extra={"publish_dir": publish_dir,
+                                   "store_version": self.store.version,
+                                   "last_poll_error":
+                                       self.last_poll_error,
+                                   "active_reasons": sorted(reasons)}))
+                    except Exception as e:  # noqa: BLE001 - never crash
+                        self._note_poll_error(e)
         return infos
 
     def _note_poll_error(self, e: BaseException) -> None:

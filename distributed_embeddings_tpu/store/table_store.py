@@ -69,6 +69,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_embeddings_tpu import faults
+from distributed_embeddings_tpu.obs.trace import default_recorder
 from distributed_embeddings_tpu.ops import sparse_update as sparse_update_ops
 from distributed_embeddings_tpu.utils import checkpoint as ckpt_lib
 
@@ -378,6 +379,9 @@ class TableStore:
             for gtid in gtids:
                 self.table_versions[gtid] = self.version
         self._since_commit = set()
+        # lineage (ISSUE 14): a commit OPENS version V's async track in
+        # the flight recorder — publish/scan/apply/serve land on it
+        default_recorder().lineage(self.version, "commit")
         return self.version
 
     def replace(self, params: dict, opt_states: Optional[dict] = None) -> int:
@@ -582,6 +586,9 @@ class TableStore:
         # run registry (the bench serve mode shape) must not flap a
         # single version gauge between the two meanings
         m.gauge("store/version", role="publisher").set(self.version)
+        default_recorder().lineage(self.version, "publish",
+                                   kind=meta["kind"], bytes=info["bytes"],
+                                   rows=n_rows)
         return info
 
     # --------------------------------------------------------- consuming
@@ -696,6 +703,8 @@ class TableStore:
         m.counter("store/apply_bytes").inc(info["bytes"])
         m.counter("store/apply_rows").inc(n_rows)
         m.gauge("store/version", role="consumer").set(self.version)
+        default_recorder().lineage(self.version, "apply",
+                                   kind=meta["kind"], rows=n_rows)
         return info
 
 
@@ -749,6 +758,9 @@ class DeltaConsumer:
         self._retries_total = 0
         self._degraded: set = set()
         self._last_scan: List[Tuple[int, str, str]] = []
+        # versions whose first directory sighting was already recorded
+        # on the lineage track (one "scan" per version per consumer)
+        self._lineage_scanned: set = set()
 
     # ------------------------------------------------------------ internals
     def _visible(self) -> List[Tuple[int, str, str]]:
@@ -859,6 +871,11 @@ class DeltaConsumer:
                                 if p in live}
         for p in [p for p in self.quarantined if p not in live]:
             del self.quarantined[p]          # counted already; file gone
+        # the scan-lineage dedup set stays bounded by IN-FLIGHT versions:
+        # applied versions can never re-emit (the emission requires
+        # version > store.version), so their entries are dead weight
+        self._lineage_scanned = {v for v in self._lineage_scanned
+                                 if v > self.store.version}
 
     def degraded_reasons(self) -> frozenset:
         """The consumer's current degradation set (empty = healthy):
@@ -875,6 +892,15 @@ class DeltaConsumer:
         entry (exercising the engine-level degradation path)."""
         faults.check_raise("consumer.poll", directory=self.directory)
         files = self._visible()
+        # lineage (ISSUE 14): the first time this consumer's directory
+        # scan SEES a not-yet-applied version, stamp it on the
+        # version's async track — the scan->apply gap is the consumer
+        # half of staleness
+        for version, _, _ in files:
+            if (version > self.store.version
+                    and version not in self._lineage_scanned):
+                self._lineage_scanned.add(version)
+                default_recorder().lineage(version, "scan")
         newer = [f for f in files if f[0] > self.store.version]
         if not newer and not self.store._chain_broken:
             self._evict_meta_cache()

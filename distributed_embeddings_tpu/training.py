@@ -826,7 +826,19 @@ def fit(model, params, data, steps: int, optimizer: str = "adagrad",
     history["metrics_snapshot"] = reg.snapshot()
     export_path = os.environ.get("DET_OBS_EXPORT")
     if export_path:
-        reg.export_jsonl(export_path, extra={"source": "fit"})
+        # fsync: this is the run's FINAL export line — the postmortem
+        # tail a crashed follow-on must still find on disk
+        reg.export_jsonl(export_path, extra={"source": "fit"}, fsync=True)
+    trace_path = os.environ.get("DET_OBS_TRACE")
+    if trace_path:
+        # flight-recorder window as a Perfetto-loadable chrome trace
+        # (ISSUE 14): span timeline + version-lineage tracks for this run
+        try:
+            from distributed_embeddings_tpu.obs.trace import (
+                default_recorder)
+            default_recorder().export(trace_path)
+        except Exception as e:  # noqa: BLE001 - accounting never kills a run
+            history["metrics_error"] = str(e)[:200]
     return params, opt_state, history
 
 

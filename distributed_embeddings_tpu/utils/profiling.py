@@ -15,7 +15,7 @@ tools exist; this module packages the two workflows:
 import contextlib
 import statistics
 import time
-from typing import Callable, NamedTuple, Sequence
+from typing import Callable, NamedTuple, Optional, Sequence
 
 import jax
 
@@ -210,7 +210,8 @@ def benchmark_batches(fn: Callable, batches: Sequence, iters: int = 20,
 
 
 @contextlib.contextmanager
-def trace(logdir: str, host_tracer_level: int = 2):
+def trace(logdir: str, host_tracer_level: int = 2,
+          python_tracer_level: Optional[int] = None):
     """Capture a jax.profiler trace for everything inside the block:
 
         with profiling.trace("/tmp/trace"):
@@ -218,12 +219,56 @@ def trace(logdir: str, host_tracer_level: int = 2):
             jax.block_until_ready(...)
 
     View with TensorBoard's profile plugin or ui.perfetto.dev.
+
+    Args:
+      host_tracer_level: TraceMe verbosity (1 critical, 2 info — the
+        default, 3 verbose).
+      python_tracer_level: 0 disables the per-python-call tracer. THE
+        knob for long captures (ISSUE 14): the python tracer emits one
+        event per interpreted call, and a multi-second bench run
+        overflows the profiler's host event buffer with them — observed
+        to silently DROP the later `TraceAnnotation` events the
+        attribution parser needs (`obs.attribution`; the kernels bench's
+        late arms lost their span windows). None (default) keeps the
+        profiler's stock behavior.
+
+    When either knob differs from the stock (2, None) the session is
+    built directly with `ProfileOptions`; if this jaxlib cannot (API
+    drift), the capture falls back to the stock tracer rather than
+    failing the run — the options are fidelity, not correctness.
     """
-    jax.profiler.start_trace(logdir)
+    if host_tracer_level == 2 and python_tracer_level is None:
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+        return
+    sess = None
+    try:
+        from jax._src.lib import xla_client
+        opts = xla_client.profiler.ProfileOptions()
+        opts.host_tracer_level = int(host_tracer_level)
+        if python_tracer_level is not None:
+            opts.python_tracer_level = int(python_tracer_level)
+        # backends must wake before the tracer (the stock start_trace
+        # does the same — on Cloud TPU a later libtpu init would miss
+        # the device tracer entirely)
+        jax.devices()
+        sess = xla_client.profiler.ProfilerSession(opts)
+    except Exception:  # noqa: BLE001 - options are best-effort fidelity
+        sess = None
+    if sess is None:
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+        return
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        sess.stop_and_export(str(logdir))
 
 
 def annotate(name: str):

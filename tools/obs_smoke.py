@@ -1,12 +1,15 @@
-"""Tier-1 observability smoke (ISSUE 11): one registry across a real
-fit -> publish -> serve loop, schema-checked, SLO-gated.
+"""Tier-1 observability smoke (ISSUE 11/14): one registry across a real
+fit -> publish -> serve loop, schema-checked, SLO-gated, device-time
+attributed.
 
 What it drives (tiny shapes, CPU, ~a minute):
 
   1. `training.fit` with the lookahead engine AND a publishing
      `TableStore`, all reporting into ONE `obs.MetricRegistry` — train
      spans/counters, ingest stage histograms, lookahead patch/compile
-     metrics, store publish counters land in the same namespace.
+     metrics, store publish counters land in the same namespace. The
+     fit runs under a REAL jax profiler capture (CPU backend), so the
+     attribution parser below works on genuine profiler output.
   2. An `InferenceEngine` replica consuming the published stream
      (`poll_updates`) and serving requests through a `MicroBatcher` on
      the SAME registry — apply/staleness/latency metrics join the
@@ -19,6 +22,17 @@ What it drives (tiny shapes, CPU, ~a minute):
      (tools/slo_tier1.json) evaluated over the snapshot — compile-count
      and audit-findings rules active, NO perf rules (CI hosts are
      steal-noisy; perf gates live in docs/perf_model.md).
+  5. Device-time attribution (ISSUE 14): the fit's profiler capture is
+     parsed by `obs.attribution`, asserting NONZERO span coverage
+     (device ops attributed to the span annotations PR 11 opened), the
+     attribution-record schema (spans + unattributed == total), and
+     the exported ``device/*`` gauges in the snapshot.
+  6. Flight-recorder checks: the ring holds the run's spans, the
+     chrome-trace export loads and balances, and the lineage tracks
+     cover every published version.
+  7. Metric-catalog drift gate: every metric FAMILY this driven run
+     observes in the snapshot must appear in docs/observability.md's
+     catalog — a new metric can no longer ship undocumented.
 
 Exit 1 on any schema violation or SLO finding. Run:
 
@@ -76,21 +90,29 @@ def check(cond, msg):
 
 def main() -> int:
     from distributed_embeddings_tpu.parallel.mesh import create_mesh
+    from distributed_embeddings_tpu.utils import profiling
     mesh = create_mesh(jax.devices()[:WORLD])
     rng = np.random.RandomState(0)
     reg = obs.default_registry()
+    obs.reset_default_recorder()      # this run's ring only (check 6)
     tmp = tempfile.mkdtemp(prefix="det_obs_smoke_")
+    profile_dir = os.path.join(tmp, "profile")
     try:
         # ---- 1. publisher fit: lookahead engine + weight streaming --
+        # under a REAL profiler capture (CPU): the attribution check
+        # below must parse genuine jax profiler output, not a fixture
         model = _programs.build_model(VOCAB, WIDTH, "sum", tables=TABLES,
                                       mesh=mesh)
         params = {"embedding": model.embedding.init(jax.random.PRNGKey(0))}
         store = TableStore(model.embedding, params["embedding"])
-        params, opt_state, history = training.fit(
-            model, params, make_batches(rng, STEPS), steps=STEPS,
-            optimizer="adagrad", lr=0.05, log_every=0, lookahead=1,
-            store=store, publish_every=PUBLISH_EVERY, publish_dir=tmp,
-            registry=reg)
+        # python tracer off: per-call python events would overflow the
+        # host buffer and drop late span annotations (profiling.trace)
+        with profiling.trace(profile_dir, python_tracer_level=0):
+            params, opt_state, history = training.fit(
+                model, params, make_batches(rng, STEPS), steps=STEPS,
+                optimizer="adagrad", lr=0.05, log_every=0, lookahead=1,
+                store=store, publish_every=PUBLISH_EVERY, publish_dir=tmp,
+                registry=reg)
         check("metrics_snapshot" in history,
               "fit history has no metrics_snapshot")
         check("metrics_error" not in history,
@@ -125,6 +147,49 @@ def main() -> int:
         if audit_ids:
             print(f"audit findings: {audit_ids}", file=sys.stderr)
 
+        # ---- 5. device-time attribution over the real capture ------
+        att = obs.attribution.attribute_logdir(profile_dir, registry=reg)
+        for field in ("total_device_seconds", "spans",
+                      "unattributed_seconds", "ambiguous_seconds",
+                      "coverage_frac", "device_op_count",
+                      "span_window_count", "collective"):
+            check(field in att, f"attribution record missing {field!r}")
+        check(att["device_op_count"] > 0, "no device ops in the capture")
+        check(att["span_window_count"] > 0,
+              "no span annotation windows in the capture")
+        check(att["spans"] and sum(att["spans"].values()) > 0,
+              "zero span coverage: no device time attributed to spans")
+        total = sum(att["spans"].values()) + att["unattributed_seconds"]
+        check(abs(total - att["total_device_seconds"]) < 1e-6,
+              f"attribution does not sum: {total} != "
+              f"{att['total_device_seconds']}")
+        check(any(p.startswith("train/step") for p in att["spans"]),
+              f"train/step not among attributed spans: "
+              f"{sorted(att['spans'])}")
+
+        # ---- 6. flight recorder: ring, export, lineage --------------
+        rec = obs.default_recorder()
+        doc = rec.export(os.path.join(tmp, "flight_trace.json"))
+        with open(os.path.join(tmp, "flight_trace.json")) as f:
+            doc2 = json.load(f)
+        check(doc2["traceEvents"] == doc["traceEvents"],
+              "flight-recorder export round trip")
+        depth = {}
+        for ev in doc["traceEvents"]:
+            if ev["ph"] == "B":
+                depth[ev["tid"]] = depth.get(ev["tid"], 0) + 1
+            elif ev["ph"] == "E":
+                depth[ev["tid"]] = depth.get(ev["tid"], 0) - 1
+                check(depth[ev["tid"]] >= 0, "unbalanced E in export")
+        check(all(v == 0 for v in depth.values()),
+              f"unbalanced spans in export: {depth}")
+        pub_versions = {i["version"] for i in history.get("published", [])
+                        if i["kind"] != "paused"}
+        lineage = set(rec.lineage_versions())
+        check(pub_versions <= lineage,
+              f"published versions {sorted(pub_versions - lineage)} "
+              "missing from lineage tracks")
+
         # ---- 4a. snapshot schema -----------------------------------
         snap = reg.snapshot()
         for section in ("counters", "gauges", "histograms"):
@@ -154,6 +219,11 @@ def main() -> int:
               "request latency count")
         check(any(k.startswith("ingest/stage_seconds") for k in h),
               "ingest stage histograms")
+        # ISSUE 14: the attribution gauges joined the same namespace
+        check(any(k.startswith("device/span_seconds") for k in g),
+              "device/span_seconds gauges")
+        check("device/unattributed_seconds" in g
+              and "device/total_seconds" in g, "device totals gauges")
 
         # ---- 4b. export round trips --------------------------------
         jsonl = os.path.join(tmp, "metrics.jsonl")
@@ -173,6 +243,29 @@ def main() -> int:
         for f in findings:
             print(f"SLO violation: {f.fid}: {f.message}", file=sys.stderr)
         check(not findings, f"{len(findings)} SLO finding(s)")
+
+        # ---- 7. metric-catalog drift gate --------------------------
+        # every family name this driven run observes must appear in
+        # docs/observability.md's catalog (wildcard rows like
+        # ``exchange/*`` cover their prefix) — new metrics can no
+        # longer ship undocumented
+        doc_path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "docs", "observability.md")
+        with open(doc_path) as f:
+            doc_text = f.read()
+        import re as _re
+        wildcards = [m.group(1) + "/"
+                     for m in _re.finditer(r"`([\w/]+)/\*`", doc_text)]
+        families = sorted({key.split("{", 1)[0]
+                           for section in snap.values()
+                           for key in section})
+        undocumented = [fam for fam in families
+                        if fam not in doc_text
+                        and not any(fam.startswith(w) for w in wildcards)]
+        check(not undocumented,
+              f"metric families missing from docs/observability.md: "
+              f"{undocumented}")
+
         print(json.dumps({
             "obs_smoke": "ok", "world": WORLD,
             "train_steps": c["train/steps"],
@@ -182,6 +275,11 @@ def main() -> int:
             "fused_compiles": g["lookahead/compiles{stage=fused}"],
             "audit_findings": len(audit_ids),
             "slo_rules_evaluated": len(obs.load_rules(rules_path)),
+            "device_coverage_frac": att["coverage_frac"],
+            "device_spans": len(att["spans"]),
+            "flight_events": len(doc["traceEvents"]),
+            "lineage_versions": sorted(lineage),
+            "metric_families_checked": len(families),
         }))
         return 0
     finally:
