@@ -1402,6 +1402,199 @@ def wire_main(argv=None) -> int:
     return 0 if "wire_error" not in record else 1
 
 
+# ----------------------------------------------------------- storedtype
+def run_storedtype_bench(vocab: int = 6000, width: int = 128,
+                         tables: int = 8, batch: int = 256,
+                         steps: int = 4, world: int = 8,
+                         optimizer: str = "adagrad", seed: int = 0) -> dict:
+    """Quantized row storage A/B (ISSUE 15): the SAME model trained and
+    published at each storage/delta dtype, from shared weights and data.
+
+    Three claims ride one record, per dtype arm:
+      * capacity — measured stream payload bytes (snapshot + delta, read
+        back from the written files) reconciled EXACTLY against the
+        shared byte model (`ops/wire.delta_row_bytes` /
+        `snapshot_row_bytes` — the same arithmetic
+        `exchange_padding_report.delta_bytes_per_step` charges), plus
+        the derived `delta_payload_reduction` / `snapshot_payload_
+        reduction` vs the f32 arm (the >= 3.5x acceptance gate at
+        width >= 128) and the quantized table's resident host bytes;
+      * parity — publish->consume round trip: the consumer's merged
+        weights against the publisher's (0.0 at f32 — the bit-exact
+        contract; within the documented per-row quantization bound
+        otherwise), and the trained-table deviation of the quantized
+        arm against the f32 arm (the SR write-back convergence claim);
+      * cost — steps/sec per arm (CPU: structural only; the projected
+        TPU win is capacity/bandwidth, docs/perf_model.md "Quantized
+        storage").
+    """
+    import tempfile
+    import jax.numpy as jnp
+    from distributed_embeddings_tpu.layers.dist_model_parallel import (
+        DistributedEmbedding)
+    from distributed_embeddings_tpu.layers.embedding import Embedding
+    from distributed_embeddings_tpu.ops import wire as wire_ops
+    from distributed_embeddings_tpu.parallel.mesh import create_mesh
+    from distributed_embeddings_tpu.store import TableStore, scan_published
+
+    devs = jax.devices()
+    if len(devs) < world:
+        return {"skipped": f"need {world} devices, have {len(devs)}"}
+    mesh = create_mesh(devs[:world])
+    # one big bucket past the device budget (the cold rows the codec
+    # exists for) + small device-resident tables (must stay f32 by the
+    # eligibility gate)
+    specs = [(vocab, width, "sum")] + [(64 + i, width, "sum")
+                                       for i in range(tables - 1)]
+    budget = (vocab * width) // 2
+
+    class _M:
+        def __init__(self, sd):
+            self.embedding = DistributedEmbedding(
+                [Embedding(v, w, combiner=c) for v, w, c in specs],
+                mesh=mesh, gpu_embedding_size=budget, storage_dtype=sd)
+
+        def loss_fn(self, p, numerical, cats, labels, taps=None,
+                    return_residuals=False):
+            out = self.embedding(p["embedding"], list(cats), taps=taps,
+                                 return_residuals=return_residuals)
+            outs, res = out if return_residuals else (out, None)
+            x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs],
+                                axis=1)
+            loss = jnp.mean((jnp.sum(x, axis=1)
+                             - labels.reshape(-1)) ** 2)
+            return (loss, res) if return_residuals else loss
+
+    rng = np.random.RandomState(seed)
+    weights0 = [rng.randn(v, w).astype(np.float32) * 0.05
+                for v, w, _ in specs]
+    num = jnp.zeros((batch, 1), jnp.float32)
+    data = [[jnp.asarray(rng.randint(0, v, size=(batch, 2))
+                         .astype(np.int32)) for v, _, _ in specs]
+            for _ in range(steps)]
+    labels = jnp.asarray(rng.randn(batch).astype(np.float32))
+
+    dtypes = ["f32", "int8"] + (["fp8"] if wire_ops.fp8_supported() else [])
+    arms, trained = {}, {}
+    for sd in dtypes:
+        model = _M(sd)
+        emb = model.embedding
+        assert emb.quantized_buckets == ([0] if sd != "f32" else []), \
+            "storedtype bench: offload/eligibility drifted"
+        init_fn, step_fn = make_sparse_train_step(
+            model, optimizer, lr=0.05, donate=False)
+        params = {"embedding": emb.set_weights(weights0)}
+        state = init_fn(params)
+        store = TableStore(emb, params["embedding"], delta_dtype=sd)
+        pub_dir = tempfile.mkdtemp(prefix=f"storedtype_{sd}_")
+        snap_info = store.publish(pub_dir)          # the anchor
+        t0 = time.perf_counter()
+        for s in range(steps):
+            store.observe(data[s])
+            params, state, loss = step_fn(params, state, num, data[s],
+                                          labels)
+        jax.block_until_ready(params["embedding"]["tp"][0])
+        dt = time.perf_counter() - t0
+        store.commit(params["embedding"], state["emb"])
+        delta_info = store.publish(pub_dir)
+        # consume into a fresh replica and compare merged weights
+        c_emb = _M(sd).embedding
+        consumer = TableStore(c_emb, c_emb.init(jax.random.PRNGKey(1)))
+        for _, _, path in scan_published(pub_dir):
+            consumer.apply_published(path)
+        pub_w = emb.get_weights(params["embedding"])
+        con_w = consumer.get_weights()
+        parity = max(float(np.abs(a - b).max())
+                     for a, b in zip(pub_w, con_w))
+        trained[sd] = pub_w
+        table0 = params["embedding"]["tp"][0]
+        scale0 = (params["embedding"]["tp_scale"][0]
+                  if sd != "f32" else None)
+        arms[sd] = {
+            "storage_dtype": sd,
+            "snapshot_payload_bytes": snap_info["payload_bytes"],
+            "snapshot_model_bytes": snap_info["model_payload_bytes"],
+            "delta_payload_bytes": delta_info["payload_bytes"],
+            "delta_model_bytes": delta_info["model_payload_bytes"],
+            "snapshot_file_bytes": snap_info["bytes"],
+            "delta_file_bytes": delta_info["bytes"],
+            "delta_rows": delta_info["rows"],
+            "bucket_resident_bytes": int(
+                table0.size * table0.dtype.itemsize
+                + (0 if scale0 is None
+                   else scale0.size * scale0.dtype.itemsize)),
+            "payload_model_reconciled": (
+                snap_info["payload_bytes"] == snap_info[
+                    "model_payload_bytes"]
+                and delta_info["payload_bytes"] == delta_info[
+                    "model_payload_bytes"]),
+            "publish_consume_parity_max_dev": parity,
+            "steps_per_sec": round(steps / dt, 3),
+        }
+    f32 = arms["f32"]
+    record = {
+        "metric": "storedtype_stream_ab", "vocab": vocab, "width": width,
+        "tables": tables, "batch": batch, "steps": steps, "world": world,
+        "optimizer": optimizer, "arms": arms,
+        "storedtype_parity_f32": f32["publish_consume_parity_max_dev"],
+    }
+    for sd in dtypes[1:]:
+        a = arms[sd]
+        a["delta_payload_reduction"] = round(
+            f32["delta_payload_bytes"] / a["delta_payload_bytes"], 3)
+        a["snapshot_payload_reduction"] = round(
+            f32["snapshot_payload_bytes"] / a["snapshot_payload_bytes"], 3)
+        a["bucket_bytes_reduction"] = round(
+            f32["bucket_resident_bytes"] / a["bucket_resident_bytes"], 3)
+        # trained-table deviation vs the f32 arm: the SR write-back
+        # convergence claim at this shape (bounded, not bit-exact)
+        a["train_table_max_dev_vs_f32"] = max(
+            float(np.abs(x - y).max())
+            for x, y in zip(trained["f32"], trained[sd]))
+    record["min_payload_reduction_required"] = 3.5
+    record["over_bound"] = bool(
+        f32["publish_consume_parity_max_dev"] != 0.0
+        or not all(arms[sd]["payload_model_reconciled"] for sd in dtypes)
+        or any(arms[sd]["delta_payload_reduction"] < 3.5
+               or arms[sd]["snapshot_payload_reduction"] < 3.5
+               for sd in dtypes[1:]))
+    return record
+
+
+def storedtype_main(argv=None) -> int:
+    """`bench.py --mode storedtype` entry point: one JSON line."""
+    import argparse
+    p = argparse.ArgumentParser(description="quantized row-storage "
+                                            "stream/parity benchmark")
+    p.add_argument("--mode", choices=["storedtype"], default="storedtype")
+    p.add_argument("--vocab", type=int, default=6000)
+    p.add_argument("--width", type=int, default=128)
+    p.add_argument("--tables", type=int, default=8)
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--world", type=int, default=8)
+    p.add_argument("--optimizer", default="adagrad",
+                   choices=["sgd", "adagrad", "adam"])
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    _load_hlo_audit()._ensure_world(max(2, args.world))
+    try:
+        record = run_storedtype_bench(
+            vocab=args.vocab, width=args.width, tables=args.tables,
+            batch=args.batch, steps=args.steps, world=args.world,
+            optimizer=args.optimizer, seed=args.seed)
+    except Exception as e:  # noqa: BLE001 - one JSON line, like main()
+        import traceback
+        traceback.print_exc()
+        record = {"metric": "storedtype_stream_ab",
+                  "storedtype_error": str(e)[:300], "git_sha": _git_sha()}
+    print(json.dumps(_stamp_metrics_snapshot(_stamp_audit_findings(record))))
+    return 0 if not record.get("over_bound", False) \
+        and "storedtype_error" not in record else 1
+
+
 # ------------------------------------------------------------- lookahead
 def run_lookahead_bench(vocab: int = 100_000, width: int = 64,
                         tables: int = 8, batch: int = 8192,
@@ -3227,6 +3420,8 @@ if __name__ == "__main__":
         sys.exit(kernels_main(sys.argv[1:]))
     elif _cli_mode() == "soak":
         sys.exit(soak_main(sys.argv[1:]))
+    elif _cli_mode() == "storedtype":
+        sys.exit(storedtype_main(sys.argv[1:]))
     elif os.environ.get("DET_BENCH_INNER") == "1":
         main()
     else:

@@ -66,8 +66,14 @@ COLLECTIVE_OPS = ("ragged_all_to_all", "all_to_all", "all_gather",
 COMPUTE_OPS = ("dot_general", "convolution")
 
 DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+               "f8E4M3FN": 1, "f8E5M2": 1, "f8E4M3": 1,
                "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
                "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1}
+
+# element types that only a declared quantized STORAGE dtype may put in
+# a program (ISSUE 15) — i1 (preds) and the int id/metadata types are
+# not storage payloads and are policed by the other passes
+QUANTIZED_STORAGE_DTYPES = ("i8", "ui8", "f8E4M3FN", "f8E5M2", "f8E4M3")
 
 _LINE_RE = re.compile(r'^\s*(%[\w]+)(?::(\d+))?\s*=\s*(.*)$')
 _OP_RE = re.compile(r'"?(stablehlo|mhlo|chlo)\.([\w.]+)"?')

@@ -30,6 +30,10 @@ fail is not a gate):
   dead-dup-collective  no two collectives with identical operand SSA
                        sources + attrs; no collective whose result has
                        empty transitive fan-out (new)
+  storage-dtype        every quantized (i8/f8*) buffer in the program is
+                       attributable to a plan bucket's declared
+                       storage_dtype — an i8 tensor in an all-f32-storage
+                       program is a storage-seam escape (ISSUE 15)
 """
 
 from __future__ import annotations
@@ -79,6 +83,10 @@ class PlanContext:
     # all_gathers (ops/wire.py ragged_exchange); padded-path programs
     # leave this False so a stray i32 collective cannot hide behind it
     ragged_emulation: bool = False
+    # declared at-rest storage dtypes over the plan's tp buckets
+    # (ISSUE 15); ('f32',) declares NO quantized buffer anywhere — the
+    # storage-dtype pass flags every i8/f8 tensor it then finds
+    storage_dtypes: Tuple[str, ...] = ("f32",)
     sort_bound: Optional[int] = None
     donate_expected: Optional[bool] = None
     # {"max_candidates": n} | {"min_candidates": n} |
@@ -322,6 +330,47 @@ def dtype_promotion_pass(mod: ir.Module,
                          "encode was dropped, the declared uncompressed "
                          "set (hot/loss psum, combiner-None) never "
                          "lowers to this op")))
+    return out
+
+
+@register_pass("storage-dtype",
+               "every quantized (i8/f8*) buffer is attributable to a "
+               "declared bucket storage_dtype (ISSUE 15)")
+def storage_dtype_pass(mod: ir.Module, ctx: PlanContext) -> List[Finding]:
+    """The wire-seam discipline applied to MEMORY: quantized element
+    types may appear in a lowered program only where a plan bucket
+    declared that storage dtype (`ops/wire.seam_storage_dtypes` maps
+    the declarations, so pass and codec cannot drift). In the default
+    all-f32-storage program the allowed set is EMPTY — any i8/f8
+    tensor is a buffer quantized outside the seam (or a stray integer
+    narrowing masquerading as one), exactly the class of silent
+    numerics change this gate exists to catch."""
+    from ..ops import wire as wire_ops
+    allowed = {d for s in ctx.storage_dtypes
+               for d in wire_ops.seam_storage_dtypes(s)}
+    hits: Dict[Tuple[str, str], List[ir.Instruction]] = {}
+    for _, inst in mod.walk():
+        for t in inst.operand_types + inst.result_types:
+            if t.dtype in ir.QUANTIZED_STORAGE_DTYPES \
+                    and t.dtype not in allowed:
+                hits.setdefault((t.dtype, inst.kind), []).append(inst)
+    out: List[Finding] = []
+    by_dtype: Dict[str, int] = {}
+    first: Dict[str, ir.Instruction] = {}
+    for (dtype, _), insts in sorted(hits.items()):
+        by_dtype[dtype] = by_dtype.get(dtype, 0) + len(insts)
+        first.setdefault(dtype, insts[0])
+    for dtype in sorted(by_dtype):
+        i0 = first[dtype]
+        out.append(Finding(
+            pass_name="storage-dtype",
+            fid=f"storage-dtype/undeclared.{dtype}",
+            severity="error", op=i0.kind, line=i0.line,
+            message=(f"{by_dtype[dtype]} op(s) carry {dtype} values but "
+                     f"no plan bucket declares a storage dtype lowering "
+                     f"to {dtype} (declared: "
+                     f"{sorted(ctx.storage_dtypes)}) — a buffer "
+                     "quantized outside the ops/wire.py storage seam")))
     return out
 
 

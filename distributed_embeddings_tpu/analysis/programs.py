@@ -61,7 +61,8 @@ def ensure_world(n: int = 8) -> int:
 def build_model(vocab: int, width: int, combiner: str, hot_rows: int = 0,
                 tables: int = 1, mesh=None, exchange_wire=None,
                 dense_head: bool = False, vocab_slack: int = 0,
-                weighted: bool = False):
+                weighted: bool = False, gpu_embedding_size=None,
+                storage_dtype=None):
     """Minimal tapped model (the shape make_sparse_train_step expects)
     around a DistributedEmbedding — THE one copy of this harness, shared
     by the audit program matrix, the legacy sort/byte/overlap arms, and
@@ -102,7 +103,9 @@ def build_model(vocab: int, width: int, combiner: str, hot_rows: int = 0,
     emb = DistributedEmbedding(
         [Embedding(vocab, width, combiner=combiner) for _ in range(tables)],
         mesh=mesh, hot_rows=hot_rows, exchange_wire=exchange_wire,
-        vocab_slack=vocab_slack or None)
+        vocab_slack=vocab_slack or None,
+        gpu_embedding_size=gpu_embedding_size,
+        storage_dtype=storage_dtype)
     return _Tapped(emb)
 
 
@@ -332,6 +335,38 @@ def program_matrix(vocab: int = 4096, width: int = 16, tables: int = 4,
             expected_bytes=expected_collective_bytes(
                 emb, [hotness] * tables, batch, train=False)),
         skip_passes=("collective-overlap",)))
+
+    # 7: quantized-storage serve forward (ISSUE 15) — an offloaded
+    # bucket at storage_dtype='int8': the lowered program must carry i8
+    # row buffers, every one attributable to the declared dtype (the
+    # storage-dtype pass is vacuous on programs 1-6, which declare
+    # ('f32',) and must lower ZERO quantized buffers). Byte model
+    # skipped: the offloaded activation return is a GSPMD resharding,
+    # not a seam collective, so expected_collective_bytes does not
+    # model this program; the wire-seam pass still polices every
+    # collective payload it does emit.
+    # per-RANK element budget (offload flags on post-slicing per-rank
+    # configs): under it every table offloads into one quantized bucket
+    q_model = build_model(vocab, width, "sum", tables=tables, mesh=mesh,
+                          gpu_embedding_size=(vocab * width) // world,
+                          storage_dtype="int8")
+    q_emb = q_model.embedding
+    assert q_emb.quantized_buckets, \
+        "quantized_store_serve: budget failed to offload any bucket"
+    q_sp = {"embedding": q_emb.init(_jax.random.PRNGKey(0))}
+    q_text = _jax.jit(
+        lambda p, i: q_emb.apply(p["embedding"], list(i))).lower(
+        q_sp, cats).as_text()
+    q_wires, q_id_wires, q_groups = _plan_wires(q_emb)
+    programs.append(Program(
+        name="quantized_store_serve", text=q_text,
+        ctx=PlanContext(
+            program="quantized_store_serve", wire_dtypes=q_wires,
+            id_wire_dtypes=q_id_wires, sort_bound=q_groups,
+            donate_expected=False,
+            storage_dtypes=tuple(sorted(
+                {b.storage_dtype for b in q_emb.plan.tp_buckets}))),
+        skip_passes=("collective-overlap",)))
     return programs
 
 
@@ -416,6 +451,16 @@ module @m {
     %1 = "stablehlo.all_gather"(%arg0) <{all_gather_dim = 0 : i64}> : (tensor<8xf32>) -> tensor<64xf32>
     %2 = stablehlo.add %0, %1 : tensor<64xf32>
     return %2 : tensor<64xf32>
+  }
+}
+"""
+
+_MUT_QUANT_BUFFER = """
+module @m {
+  func.func public @main(%arg0: tensor<8x4xf32>) -> tensor<8x4xf32> {
+    %0 = stablehlo.convert %arg0 : (tensor<8x4xf32>) -> tensor<8x4xi8>
+    %1 = stablehlo.convert %0 : (tensor<8x4xi8>) -> tensor<8x4xf32>
+    return %1 : tensor<8x4xf32>
   }
 }
 """
@@ -517,6 +562,16 @@ def mutation_cases() -> List[MutationCase]:
             name="f32-leak-on-bf16-wire", pass_name="dtype-promotion",
             text=_MUT_FREE_COLLECTIVE, ctx=bf16_ctx,
             expect_fids=("dtype-promotion/f32-wire-leak.all_to_all",)),
+        MutationCase(
+            # ISSUE 15: an int8 buffer in a program whose plan declares
+            # only f32 storage — a row table quantized outside the
+            # ops/wire.py storage seam (the blind-gate fixture of the
+            # storage-dtype pass)
+            name="quantized-buffer-under-f32-storage",
+            pass_name="storage-dtype", text=_MUT_QUANT_BUFFER,
+            ctx=PlanContext(program="mutation",
+                            storage_dtypes=("f32",)),
+            expect_fids=("storage-dtype/undeclared.i8",)),
         MutationCase(
             name="self-duplicated-collective",
             pass_name="dead-dup-collective", text=_MUT_DUP_COLLECTIVE,

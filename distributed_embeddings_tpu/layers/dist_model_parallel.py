@@ -34,10 +34,11 @@ planner's placement strategies already minimize.
 """
 
 import contextlib
+import functools
 import logging
 import math
 import os
-from typing import Any, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 import jax
@@ -337,6 +338,18 @@ class DistributedEmbedding:
         plan). The slack inflates the table's physical shape: `init`,
         `get_weights`/`set_weights` and checkpoints all see
         ``input_dim + vocab_slack`` rows for managed tables.
+      storage_dtype: at-rest row storage for COLD (host-offloaded)
+        buckets (ISSUE 15): 'f32' (default — params byte-identical to
+        the pre-seam layer, the `exchange_wire='f32'` contract applied
+        to memory), 'int8' (per-row-scaled symmetric quantization: ~4x
+        more rows per host byte, rows decode to f32 at gather time,
+        training write-back rounds stochastically with the wire seam's
+        keyless hash), or 'fp8' (float8_e4m3fn payload where the
+        backend ships it). None defers to ``DET_STORE_DTYPE``.
+        Quantized buckets carry their per-row scales in a
+        ``params['tp_scale']`` leaf (present only when some bucket
+        quantizes, so default pytrees are unchanged); device-resident
+        buckets always stay f32 (parallel/plan._storage_eligibility).
     """
 
     def __init__(self,
@@ -355,7 +368,8 @@ class DistributedEmbedding:
                  compute_dtype: Optional[Any] = None,
                  hot_rows: Optional[int] = None,
                  exchange_wire: Optional[str] = None,
-                 vocab_slack: Optional[int] = None):
+                 vocab_slack: Optional[int] = None,
+                 storage_dtype: Optional[str] = None):
         if mesh is None and world_size is not None and world_size > 1:
             mesh = create_mesh(jax.devices()[:world_size])
         self.mesh = mesh
@@ -388,7 +402,8 @@ class DistributedEmbedding:
             input_hotness=input_max_hotness,
             hot_rows=(hot_rows if dp_input else 0),
             exchange_wire=exchange_wire,
-            vocab_slack=vocab_slack)
+            vocab_slack=vocab_slack,
+            storage_dtype=storage_dtype)
 
         if self.strategy.table_groups[1]:
             if not all(self.strategy.local_configs):
@@ -503,6 +518,66 @@ class DistributedEmbedding:
                     "but this backend exposes no host memory space: "
                     "offloaded buckets remain device-resident and count "
                     "against device memory.", RuntimeWarning, stacklevel=2)
+        # quantized at-rest storage (ISSUE 15) rides the offload lookup
+        # seam: with offload runtime-disabled the bucket's gathers run
+        # INSIDE the shard_map with no decode hook — demote to f32
+        # loudly rather than serve raw int8 rows as embeddings
+        if not self._offload_enabled and any(
+                b.storage_dtype != "f32" for b in self.plan.tp_buckets):
+            import warnings
+            warnings.warn(
+                "storage_dtype quantization demoted to f32: host offload "
+                "is disabled on this backend and quantized storage "
+                "decodes at the offloaded-gather seam.",
+                RuntimeWarning, stacklevel=2)
+            for b in self.plan.tp_buckets:
+                b.storage_dtype = "f32"
+        # jitted per-bucket storage codec fns (decode at gather /
+        # SR re-encode at write-back), cached per bucket
+        self._store_codec_cache: dict = {}
+
+    def _bucket_store_dtype(self, b: int) -> str:
+        """The at-rest storage dtype of tp bucket b ('f32' | 'int8' |
+        'fp8') — THE one predicate every storage-seam branch keys on."""
+        return self.plan.tp_buckets[b].storage_dtype
+
+    @property
+    def quantized_buckets(self) -> list:
+        """Buckets whose rows are stored quantized (ISSUE 15)."""
+        return [b for b, bk in enumerate(self.plan.tp_buckets)
+                if bk.storage_dtype != "f32"]
+
+    def _bucket_scale(self, params: dict, b: int):
+        """The per-row scale leaf of bucket b, or None at f32 storage.
+        A QUANTIZED bucket with no scale leaf fails loudly here — the
+        read-side twin of `host_bucket_apply`'s drift guard; falling
+        through to the f32 path would serve raw int8/fp8 payload codes
+        as embedding values."""
+        scales = params.get("tp_scale")
+        scale = None if scales is None else scales[b]
+        if scale is None and self._bucket_store_dtype(b) != "f32":
+            raise ValueError(
+                f"bucket {b} stores {self._bucket_store_dtype(b)} rows "
+                "but params carries no tp_scale leaf for it — the "
+                "pytree drifted from the plan (rebuild params via "
+                "init/set_weights; a hand-stripped checkpoint cannot "
+                "decode)")
+        return scale
+
+    def _encoded_shard_fn(self, shard_fn, encoder):
+        """(rank, b, part) accessor over quantized bucket shards with
+        ONE encode per (bucket, rank): the payload (part 0) and scale
+        (part 1) stack builders each ask for one half of the same
+        encode. THE shared assembly core of `init` (jnp encoder) and
+        `set_weights` (numpy encoder) — ISSUE 15."""
+        cache: dict = {}
+
+        def part(rank: int, b: int, idx: int):
+            if (b, rank) not in cache:
+                cache[(b, rank)] = encoder(shard_fn(rank, b),
+                                           self._bucket_store_dtype(b))
+            return cache[(b, rank)][idx]
+        return part
 
     def plan_widths(self) -> tuple:
         """The distinct table lane widths of this plan (tp buckets + row
@@ -631,15 +706,28 @@ class DistributedEmbedding:
                 jax.random.fold_in(kd, j),
                 (cfg["input_dim"], cfg["output_dim"]),
                 cfg.get("dtype") or jnp.float32))
+        qbs = self.quantized_buckets
+        scales: Dict[int, jax.Array] = {}
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
             params["dp"] = [jax.device_put(a, rep) for a in params["dp"]]
             tp_init = jax.jit(self._tp_shard, static_argnums=(1, 2))
             row_init = jax.jit(self._row_shard, static_argnums=(1, 2))
+            q_shard = self._encoded_shard_fn(
+                lambda rank, b: tp_init(kt, b, rank), wire_ops.encode_rows)
             for b in range(len(self.plan.tp_buckets)):
-                params["tp"].append(self._stack_sharded(
-                    lambda rank, b=b: tp_init(kt, b, rank),
-                    memory_kind=self._bucket_memory_kind(b)))
+                mk = self._bucket_memory_kind(b)
+                if b in qbs:
+                    params["tp"].append(self._stack_sharded(
+                        lambda rank, b=b: q_shard(rank, b, 0),
+                        memory_kind=mk))
+                    scales[b] = self._stack_sharded(
+                        lambda rank, b=b: q_shard(rank, b, 1),
+                        memory_kind=mk)
+                else:
+                    params["tp"].append(self._stack_sharded(
+                        lambda rank, b=b: tp_init(kt, b, rank),
+                        memory_kind=mk))
             for t in range(len(self.plan.row_tables)):
                 params["row"].append(self._stack_sharded(
                     lambda rank, t=t: row_init(kr, t, rank)))
@@ -653,13 +741,25 @@ class DistributedEmbedding:
                 arr = jnp.stack(
                     [tp_init(kt, b, r) for r in range(self.world_size)])
                 mk = self._bucket_memory_kind(b)
+                scale = None
+                if b in qbs:
+                    arr, scale = wire_ops.encode_rows(
+                        arr, self._bucket_store_dtype(b))
                 if mk:
-                    arr = jax.device_put(arr, jax.sharding.SingleDeviceSharding(
-                        jax.devices()[0], memory_kind=mk))
+                    hsh = jax.sharding.SingleDeviceSharding(
+                        jax.devices()[0], memory_kind=mk)
+                    arr = jax.device_put(arr, hsh)
+                    if scale is not None:
+                        scale = jax.device_put(scale, hsh)
                 params["tp"].append(arr)
+                if scale is not None:
+                    scales[b] = scale
             for t in range(len(self.plan.row_tables)):
                 params["row"].append(jnp.stack(
                     [row_init(kr, t, r) for r in range(self.world_size)]))
+        if qbs:
+            params["tp_scale"] = [scales.get(b)
+                                  for b in range(len(self.plan.tp_buckets))]
         if self._hot_buckets:
             params["hot"] = self._init_hot_params()
         return params
@@ -686,6 +786,11 @@ class DistributedEmbedding:
             "tp": [tp_shard(b) for b in range(len(self.plan.tp_buckets))],
             "row": [shard0 for _ in self.plan.row_tables],
         }
+        if self.quantized_buckets:
+            # per-row scales co-locate with their quantized bucket
+            out["tp_scale"] = [tp_shard(b) if b in self.quantized_buckets
+                               else None
+                               for b in range(len(self.plan.tp_buckets))]
         if self._hot_buckets:
             out["hot"] = [({"ids": rep, "rows": rep}
                            if b in self._hot_buckets else None)
@@ -789,7 +894,8 @@ class DistributedEmbedding:
 
     def exchange_padding_report(self, hotness=None,
                                 hot_hit_rate=None, batch: int = 1,
-                                vocab=None, lookahead: int = 0) -> dict:
+                                vocab=None, lookahead: int = 0,
+                                delta_dtype: Optional[str] = None) -> dict:
         """Static accounting of the dp->mp id-exchange volume.
 
         The exchange sends one dense [world, f_max, k] id block per
@@ -850,13 +956,20 @@ class DistributedEmbedding:
         canonical scatter, so the post-hot volume is the base; the
         dedup bound is the bucket's total row count) — and
         `delta_bytes_per_step`, the row-delta size model built on it:
-        ``(touched + republished hot hits) * (8 id bytes + 4 * width
-        payload bytes)`` — hot-HIT rows skip the canonical scatter but
-        still move the replicated hot shard, so the published delta
-        republishes their merged values (bounded by the hot capacity).
-        This is the numerator of the delta-vs-full-copy ratio the
-        weight-streaming store publishes at (docs/perf_model.md
-        "Weight streaming").
+        ``(touched + republished hot hits) *
+        wire.delta_row_bytes(width, delta_dtype)`` — 8 id bytes plus
+        the width-element payload at the STREAM's storage dtype plus
+        its per-row scale (`delta_dtype=None` defers to
+        ``DET_DELTA_DTYPE``; 'f32' reproduces the historical
+        ``8 + 4*width`` exactly). Hot-HIT rows skip the canonical
+        scatter but still move the replicated hot shard, so the
+        published delta republishes their merged values (bounded by
+        the hot capacity). `wire.delta_row_bytes` is THE shared byte
+        model: `TableStore.publish`'s payload accounting and the bench
+        reconcile against the same formula, the
+        `expected_collective_bytes` discipline applied to the stream
+        (docs/perf_model.md "Weight streaming"). Each group also
+        reports its bucket's at-rest `storage_dtype` (ISSUE 15).
 
         Dynamic vocabulary (ISSUE 7): every group also carries the
         bucket's capacity accounting — `slack_rows` (growth rows the
@@ -907,6 +1020,8 @@ class DistributedEmbedding:
         "prefetch_patch_rows_per_step", "prefetch_patch_bytes_per_step"}.
         """
         tp_inputs = self.strategy.input_groups[1]
+        delta_dtype = (wire_ops.default_delta_dtype() if delta_dtype is None
+                       else wire_ops.resolve_store_dtype(delta_dtype))
         if hotness is None:
             mh = self.input_max_hotness or [None] * self._n_inputs
             hotness = [mh[i] or 1 for i in tp_inputs]
@@ -1003,6 +1118,7 @@ class DistributedEmbedding:
                 "true_ids": true_ids, "exchanged_ids": ex_ids,
                 "wire_dtype": bucket.wire_dtype,
                 "id_wire_dtype": bucket.id_wire_dtype,
+                "storage_dtype": bucket.storage_dtype,
                 "act_width": w_out,
                 "act_bytes": act_ex * wire_b,
                 "act_bytes_f32": act_ex * 4,
@@ -1037,7 +1153,8 @@ class DistributedEmbedding:
                           bucket.hot_rows)
             entry["touched_rows_per_step"] = touched
             entry["delta_bytes_per_step"] = (
-                (touched + hot_pub) * (8 + 4 * bucket.width))
+                (touched + hot_pub)
+                * wire_ops.delta_row_bytes(bucket.width, delta_dtype))
             touched_tot += touched
             delta_bytes_tot += entry["delta_bytes_per_step"]
             # lookahead overlap-window model (ISSUE 9): worst case, every
@@ -1071,6 +1188,9 @@ class DistributedEmbedding:
                 "hot_hit_rates": {b: rate_for(b) for b in self._hot_buckets},
                 "touched_rows_per_step": touched_tot,
                 "delta_bytes_per_step": delta_bytes_tot,
+                "delta_dtype": delta_dtype,
+                "storage_dtypes": {b: bk.storage_dtype for b, bk in
+                                   enumerate(self.plan.tp_buckets)},
                 "lookahead": int(lookahead),
                 "prefetch_patch_rows_per_step": patch_rows_tot,
                 "prefetch_patch_bytes_per_step": patch_bytes_tot,
@@ -1794,7 +1914,7 @@ class DistributedEmbedding:
         return out
 
     def _host_group_exchange(self, table_h: jax.Array, grp, ids_g, w_g, tap,
-                             g: int):
+                             g: int, scale_h=None):
         """Offloaded-bucket lookup: gather+combine in pinned host memory
         (compute_on 'device_host'), stream only combined [B, f, w_out] rows
         to the device, then reshard owner-major -> batch-major (the GSPMD
@@ -1803,17 +1923,23 @@ class DistributedEmbedding:
         kernels (dist_model_parallel.py:829-831).
 
         ids_g: [world, B, f, k] device-sharded exchanged absolute rows;
-        w_g: matching effective weights or None; tap: optional perturbation.
+        w_g: matching effective weights or None; tap: optional perturbation;
+        scale_h: the bucket's per-row scale stack for quantized storage
+        (ISSUE 15) — rows gather at the stored dtype and DECODE here, in
+        the same host region as the gather, so only the touched rows'
+        payloads+scales ever move and only f32 combined rows go device-ward.
         """
         bucket = self.plan.tp_buckets[grp.bucket]
         world = self.world_size
         k, wf = grp.k, bucket.width
+        store_dtype = bucket.storage_dtype
         # bucket identity must key the cache: the same group index can map
         # to a different bucket under another hotness signature, and the
         # closure bakes in rows_max / combiner / scale
         key = (g, grp.bucket, bucket.combiner, ids_g.shape,
                None if w_g is None else w_g.shape,
-               None if tap is None else tap.shape)
+               None if tap is None else tap.shape,
+               None if scale_h is None else store_dtype)
         fn = self._host_fn_cache.get(key)
         if fn is None:
             combiner = bucket.combiner
@@ -1835,7 +1961,7 @@ class DistributedEmbedding:
                     dev0, memory_kind=self._host_kind)
                 dev_sh = jax.sharding.SingleDeviceSharding(dev0)
 
-            def run(table_h, ids_g, w_g, tap):
+            def run(table_h, scale_h, ids_g, w_g, tap):
                 B, f = ids_g.shape[1], ids_g.shape[2]
                 ids = jnp.clip(ids_g, 0, rows_max - 1).reshape(world, -1)
                 ids_h = jax.device_put(ids, host_sh())
@@ -1846,6 +1972,15 @@ class DistributedEmbedding:
                 with compute_on.compute_on("device_host"):
                     rows = jax.vmap(sparse_update_ops.take_rows)(
                         table_h, ids_h)                    # [world, N, wf]
+                    if scale_h is not None:
+                        # decode-at-gather (ISSUE 15): per-row scales
+                        # gather beside their payload rows, all inside
+                        # the host region — device-ward traffic stays
+                        # the combined f32 rows, exactly the f32 path's
+                        srow = jax.vmap(sparse_update_ops.take_rows)(
+                            scale_h, ids_h)                # [world, N, 1]
+                        rows = wire_ops.decode_rows(rows, srow,
+                                                    store_dtype)
                     if combiner is None:
                         out_h = rows.reshape(world, B, f, k * wf)
                     else:
@@ -1866,7 +2001,7 @@ class DistributedEmbedding:
 
             fn = jax.jit(run)
             self._host_fn_cache[key] = fn
-        return fn(table_h, ids_g, w_g, tap)
+        return fn(table_h, scale_h, ids_g, w_g, tap)
 
     def offload_lookup_scope(self, lookup_fn):
         """Scope an offloaded-bucket lookup override over forwards.
@@ -1892,14 +2027,20 @@ class DistributedEmbedding:
                 self._offload_lookup_override = prev
         return scope()
 
-    def _offload_group_out(self, g, grp, table, off_id, off_w, tap_g):
+    def _offload_group_out(self, g, grp, table, scale, off_id, off_w,
+                           tap_g):
         """One offloaded group's output: the serving override when scoped
-        (and tapless), else the host-memory gather+combine."""
-        if tap_g is None and self._offload_lookup_override is not None:
+        (and tapless, and the bucket stores f32 — the override contract
+        hands RAW table rows to the cache, which a quantized bucket
+        cannot honor without the decode seam), else the host-memory
+        gather+combine (decode-at-gather for quantized storage)."""
+        if (tap_g is None and scale is None
+                and self._offload_lookup_override is not None):
             out = self._offload_lookup_override(g, grp, table, off_id, off_w)
             if out is not None:
                 return out
-        return self._host_group_exchange(table, grp, off_id, off_w, tap_g, g)
+        return self._host_group_exchange(table, grp, off_id, off_w, tap_g,
+                                         g, scale_h=scale)
 
     def _tp_bucket_exchange(self, out: jax.Array,
                             wire: str = "f32") -> jax.Array:
@@ -2200,7 +2341,9 @@ class DistributedEmbedding:
             grp = groups[g]
             tap_g = taps["tp"][g] if taps is not None else None
             ex_list[g] = self._offload_group_out(
-                g, grp, params["tp"][grp.bucket], off_ids[g], off_w[g], tap_g)
+                g, grp, params["tp"][grp.bucket],
+                self._bucket_scale(params, grp.bucket),
+                off_ids[g], off_w[g], tap_g)
 
         # ---- assemble per-input outputs ------------------------------------
         dp_final = []
@@ -2769,7 +2912,9 @@ class DistributedEmbedding:
             grp = groups[g]
             tap_g = taps["tp"][g] if taps is not None else None
             ex_list[g] = self._offload_group_out(
-                g, grp, params["tp"][grp.bucket], off_ids[g], off_w[g], tap_g)
+                g, grp, params["tp"][grp.bucket],
+                self._bucket_scale(params, grp.bucket),
+                off_ids[g], off_w[g], tap_g)
 
         outputs = self._assemble_tp_outputs(ex_list, tp_preps, batch,
                                             groups, assembly)
@@ -3033,7 +3178,10 @@ class DistributedEmbedding:
             # host memory via numpy (XLA cannot emit host-placed outputs on
             # every backend, and a device-side init would need HBM the
             # offloaded bucket was too big for in the first place)
-            tiny = opt.init(jnp.zeros((1, stack.shape[-1]), stack.dtype))
+            # f32 probe regardless of the stack's storage dtype: the
+            # optimizer state of a quantized (int8/fp8) bucket is f32 —
+            # only the TABLE is stored compressed (ISSUE 15)
+            tiny = opt.init(jnp.zeros((1, stack.shape[-1]), jnp.float32))
             out = []
             for x in tiny:
                 if getattr(x, "ndim", 0) == 2:
@@ -3179,6 +3327,10 @@ class DistributedEmbedding:
                                                 residuals)
                    for b in off_buckets}
         new_params = {"dp": params["dp"], "tp": new_tp, "row": new_row}
+        if "tp_scale" in params:
+            # quantized-storage scales (ISSUE 15) are read-only inside
+            # the jitted step; the out-of-jit host apply refreshes them
+            new_params["tp_scale"] = params["tp_scale"]
         new_states = {"tp": new_tp_s, "row": new_row_s}
         if "hot" in params:
             new_hot = list(params["hot"])
@@ -3209,7 +3361,56 @@ class DistributedEmbedding:
                 grad.ids, grad.contribs)
 
     def host_bucket_apply(self, b, table_h, state_h, rep, sums, valid,
-                          opt: SparseOptimizer, lr_value=None):
+                          opt: SparseOptimizer, lr_value=None,
+                          scale_h=None):
+        """Storage-dtype dispatch over `_host_bucket_apply_f32` (ISSUE
+        15). f32 buckets pass straight through (bit-exact, the
+        early-return contract). Quantized buckets round-trip through
+        f32: decode (payload, scale) -> run the stock f32 apply (same
+        modes, same optimizer math — state stays f32 master-free of the
+        TABLE only) -> re-encode with the wire seam's keyless hash-SR,
+        so the write-back rounding error centers on zero across a
+        step's many updated values instead of accumulating RNE bias.
+        Returns (table, state) at f32 and (payload, scale, state) when
+        `scale_h` is given. The decode/encode pair is whole-bucket AND
+        transits default device memory (plain jits — the host-compute
+        codec shares the `native` mode's backend gaps), so a quantized
+        bucket's apply costs a roundtrip-class transfer per step and
+        needs the decoded f32 bucket to FIT on device: the honest v1.
+        The touched-rows-only host-kernel epilogue that removes both
+        costs is ROADMAP item 2's remaining work."""
+        sd = self._bucket_store_dtype(b)
+        if sd == "f32":
+            if scale_h is not None:
+                raise ValueError(
+                    f"bucket {b} stores f32 rows but a scale leaf was "
+                    "passed — params['tp_scale'] drifted from the plan")
+            return self._host_bucket_apply_f32(
+                b, table_h, state_h, rep, sums, valid, opt,
+                lr_value=lr_value)
+        if scale_h is None:
+            raise ValueError(
+                f"bucket {b} stores {sd} rows: host_bucket_apply needs "
+                "the params['tp_scale'] leaf alongside the payload")
+        ckey = ("store_codec", b, sd)
+        codec = self._store_codec_cache.get(ckey)
+        if codec is None:
+            codec = (jax.jit(functools.partial(wire_ops.decode_rows,
+                                               store_dtype=sd)),
+                     jax.jit(functools.partial(wire_ops.encode_rows,
+                                               store_dtype=sd, sr=True)))
+            self._store_codec_cache[ckey] = codec
+        decode, encode_sr = codec
+        back = table_h.sharding
+        table_f = jax.device_put(decode(table_h, scale_h), back)
+        new_f, new_state = self._host_bucket_apply_f32(
+            b, table_f, state_h, rep, sums, valid, opt, lr_value=lr_value)
+        payload, scale = encode_sr(new_f)
+        return (jax.device_put(payload, back), jax.device_put(scale, back),
+                new_state)
+
+    def _host_bucket_apply_f32(self, b, table_h, state_h, rep, sums, valid,
+                               opt: SparseOptimizer, lr_value=None):
         """Apply deduped rows to an offloaded bucket's host-resident table.
 
         Three implementations, best-available (force with DET_HOST_APPLY=
@@ -3992,7 +4193,8 @@ class DistributedEmbedding:
         del all_ranks  # SPMD: every process sees the global jax.Array
         cache: dict = {}
         if self.mesh is not None and jax.process_count() > 1:
-            for arr in list(params["tp"]) + list(params["row"]):
+            scales = [s for s in params.get("tp_scale", []) if s is not None]
+            for arr in list(params["tp"]) + list(params["row"]) + scales:
                 if (hasattr(arr, "is_fully_addressable")
                         and not arr.is_fully_addressable):
                     cache[id(arr)] = self._gather_global_chunked(arr)
@@ -4010,6 +4212,14 @@ class DistributedEmbedding:
                               key=lambda p: p.col_start):
                 shard = self._shard_host(params["tp"][pl_.bucket], pl_.rank,
                                          cache)
+                sd = self._bucket_store_dtype(pl_.bucket)
+                if sd != "f32":
+                    # quantized storage (ISSUE 15): the portable dump is
+                    # ALWAYS f32 — decode payload x per-row scale here,
+                    # so checkpoints/streams stay format-stable
+                    sshard = self._shard_host(
+                        params["tp_scale"][pl_.bucket], pl_.rank, cache)
+                    shard = wire_ops.decode_rows_np(shard, sshard, sd)
                 cols.append(shard[pl_.row_offset:pl_.row_offset + pl_.rows, :])
             out[gtid] = np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
 
@@ -4089,29 +4299,57 @@ class DistributedEmbedding:
             arr[:rows, :] = weights[gtid][start:start + rows, :]
             return arr
 
+        qbs = self.quantized_buckets
+        scales: Dict[int, jax.Array] = {}
+        q_shard = self._encoded_shard_fn(tp_shard, wire_ops.encode_rows_np)
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
             new["dp"] = [jax.device_put(a, rep) for a in new["dp"]]
             for b in range(len(self.plan.tp_buckets)):
-                new["tp"].append(self._stack_sharded(
-                    lambda rank, b=b: tp_shard(rank, b),
-                    memory_kind=self._bucket_memory_kind(b)))
+                mk = self._bucket_memory_kind(b)
+                if b in qbs:
+                    new["tp"].append(self._stack_sharded(
+                        lambda rank, b=b: q_shard(rank, b, 0),
+                        memory_kind=mk))
+                    scales[b] = self._stack_sharded(
+                        lambda rank, b=b: q_shard(rank, b, 1),
+                        memory_kind=mk)
+                else:
+                    new["tp"].append(self._stack_sharded(
+                        lambda rank, b=b: tp_shard(rank, b),
+                        memory_kind=mk))
             for t_local, gtid in enumerate(strat.table_groups[2]):
                 new["row"].append(self._stack_sharded(
                     lambda rank, t=t_local, g=gtid: row_shard(rank, t, g)))
         else:
             for b in range(len(self.plan.tp_buckets)):
-                arr = jnp.stack([jnp.asarray(tp_shard(r, b))
-                                 for r in range(self.world_size)])
                 mk = self._bucket_memory_kind(b)
+                scale = None
+                if b in qbs:
+                    arr = np.stack([q_shard(r, b, 0)
+                                    for r in range(self.world_size)])
+                    scale = jnp.asarray(np.stack(
+                        [q_shard(r, b, 1) for r in range(self.world_size)]))
+                    arr = jnp.asarray(arr)
+                else:
+                    arr = jnp.stack([jnp.asarray(tp_shard(r, b))
+                                     for r in range(self.world_size)])
                 if mk:
-                    arr = jax.device_put(arr, jax.sharding.SingleDeviceSharding(
-                        jax.devices()[0], memory_kind=mk))
+                    hsh = jax.sharding.SingleDeviceSharding(
+                        jax.devices()[0], memory_kind=mk)
+                    arr = jax.device_put(arr, hsh)
+                    if scale is not None:
+                        scale = jax.device_put(scale, hsh)
                 new["tp"].append(arr)
+                if scale is not None:
+                    scales[b] = scale
             for t_local, gtid in enumerate(strat.table_groups[2]):
                 new["row"].append(jnp.stack(
                     [jnp.asarray(row_shard(r, t_local, gtid))
                      for r in range(self.world_size)]))
+        if qbs:
+            new["tp_scale"] = [scales.get(b)
+                               for b in range(len(self.plan.tp_buckets))]
         if self._hot_buckets:
             # global weights are the canonical tables; the hot set starts
             # empty (re-admit + sync after loading to repopulate it)

@@ -60,11 +60,26 @@ from jax import lax
 __all__ = [
     "WIRE_FORMATS",
     "ID_WIRE_FORMATS",
+    "STORE_DTYPES",
     "default_exchange_wire",
     "default_id_wire",
+    "default_store_dtype",
+    "default_delta_dtype",
     "resolve_wire",
+    "resolve_store_dtype",
+    "fp8_supported",
     "wire_itemsize",
     "id_wire_itemsize",
+    "store_itemsize",
+    "store_scale_bytes",
+    "delta_row_bytes",
+    "snapshot_row_bytes",
+    "encode_rows",
+    "decode_rows",
+    "encode_rows_np",
+    "decode_rows_np",
+    "store_decode_bound",
+    "seam_storage_dtypes",
     "encode_fwd",
     "encode_bwd",
     "stochastic_round_bf16",
@@ -86,6 +101,22 @@ __all__ = [
 
 WIRE_FORMATS = ("f32", "bf16", "bf16-sr")
 ID_WIRE_FORMATS = ("int32", "int16")
+
+# storage dtypes of rows AT REST (ISSUE 15): the wire seam extended to
+# memory. 'f32' is the bit-exact default (every storage path
+# early-returns to the pre-seam arrays/files); 'int8' stores a row as
+# int8 payload + ONE f32 per-row scale (scale = amax/127 — symmetric
+# linear quantization, the classic row-wise scheme); 'fp8' stores
+# float8_e4m3fn payload + per-row scale (scale = amax/448, the e4m3
+# finite max) where the backend ships the dtype. One codec covers every
+# row store that rides the train-to-serve spine: cold/offloaded bucket
+# tables (decode at gather time), `store/` delta + snapshot stream
+# payloads, and the vocab demotion stash.
+STORE_DTYPES = ("f32", "int8", "fp8")
+
+# quantization grids: payload magnitudes the per-row scale normalizes to
+INT8_AMAX = 127.0
+FP8_AMAX = 448.0          # float8_e4m3fn largest finite value
 
 # clip ceiling of the int16 id wire; the planner admits a bucket only when
 # every legal wire value (valid ids AND the hot sentinel rows_max) is
@@ -129,6 +160,187 @@ def wire_itemsize(name: str) -> int:
 
 def id_wire_itemsize(name: str) -> int:
     return 2 if name == "int16" else 4
+
+
+# ------------------------------------------------------- storage codec
+def default_store_dtype() -> str:
+    """The ``DET_STORE_DTYPE`` environment default for the at-rest row
+    storage dtype ('f32' unless overridden); an explicit
+    ``storage_dtype=`` constructor argument always wins. Per-bucket
+    eligibility (only cold/offloaded buckets quantize) is decided at
+    plan lowering time, like the exchange wire."""
+    return resolve_store_dtype(os.environ.get("DET_STORE_DTYPE"))
+
+
+def default_delta_dtype() -> str:
+    """``DET_DELTA_DTYPE``: payload dtype of published `store/` delta and
+    snapshot stream files ('f32' default — byte-identical files to the
+    pre-seam container). Independent of the table storage dtype: a
+    fleet can stream int8 deltas to serving replicas whose tables are
+    f32-resident, and vice versa."""
+    return resolve_store_dtype(os.environ.get("DET_DELTA_DTYPE"))
+
+
+def resolve_store_dtype(name: Optional[str]) -> str:
+    """Validate/normalize a storage-dtype name (None -> 'f32')."""
+    if name is None or name == "":
+        return "f32"
+    if name not in STORE_DTYPES:
+        raise ValueError(
+            f"unknown storage dtype {name!r}; expected one of "
+            f"{STORE_DTYPES}")
+    if name == "fp8" and not fp8_supported():
+        raise ValueError(
+            "storage dtype 'fp8' requested but this backend ships no "
+            "float8_e4m3fn (jax.numpy / ml_dtypes too old) — use 'int8' "
+            "or 'f32'")
+    return name
+
+
+def fp8_supported() -> bool:
+    """True when the toolchain ships float8_e4m3fn end to end (jnp for
+    the device codec, ml_dtypes for the host/stream codec)."""
+    if not hasattr(jnp, "float8_e4m3fn"):
+        return False
+    try:
+        import ml_dtypes  # noqa: F401
+        return hasattr(ml_dtypes, "float8_e4m3fn")
+    except ImportError:
+        return False
+
+
+def store_itemsize(name: str) -> int:
+    """Bytes per element a row payload occupies at rest."""
+    return 4 if resolve_store_dtype(name) == "f32" else 1
+
+
+def store_scale_bytes(name: str) -> int:
+    """Per-row scale overhead bytes (one f32 per quantized row)."""
+    return 0 if resolve_store_dtype(name) == "f32" else 4
+
+
+def delta_row_bytes(width: int, dtype: str) -> int:
+    """Bytes ONE published delta row costs at `dtype`: the 8-byte int64
+    flat key + the width-element payload + the per-row scale. THE shared
+    byte model: `exchange_padding_report`'s `delta_bytes_per_step`, the
+    store's publish accounting, and the bench's measured-vs-model
+    reconciliation all charge through this one formula (the
+    `expected_collective_bytes` discipline applied to the stream)."""
+    return 8 + width * store_itemsize(dtype) + store_scale_bytes(dtype)
+
+
+def snapshot_row_bytes(width: int, dtype: str) -> int:
+    """Bytes one snapshot table row costs at `dtype` (no key — snapshots
+    carry whole tables in row order)."""
+    return width * store_itemsize(dtype) + store_scale_bytes(dtype)
+
+
+def store_decode_bound(rows, dtype: str, sr: bool = False):
+    """Per-element absolute error bound of one encode/decode round trip
+    at `dtype`, given the f32 `rows` ([..., width]): int8 RNE rounds to
+    the nearest grid point (half a step, amax/254 per row; a full step
+    amax/127 under SR), fp8-e4m3 keeps 3 mantissa bits (relative 2^-4 of
+    the row amax after scaling). 0.0 at f32 — the bit-exact contract.
+    Returns a [...]-shaped per-row bound (numpy)."""
+    import numpy as np
+    rows = np.asarray(rows, np.float32)
+    amax = np.max(np.abs(rows), axis=-1)
+    dtype = resolve_store_dtype(dtype)
+    if dtype == "f32":
+        return np.zeros_like(amax)
+    if dtype == "int8":
+        return amax / INT8_AMAX * (1.0 if sr else 0.5)
+    return amax * (2.0 ** -4) * (2.0 if sr else 1.0)
+
+
+def _row_scale(amax, grid_amax: float):
+    """Per-row scale from the row amax; zero rows take scale 1 so the
+    round trip reproduces exact zeros."""
+    return jnp.where(amax > 0, amax / grid_amax, 1.0)
+
+
+def encode_rows(rows: jax.Array, store_dtype: str, sr: bool = False,
+                salt: int = 0x85EBCA6B):
+    """f32 rows [..., width] -> (payload [..., width], scale [..., 1]).
+
+    'f32' is the identity (scale is None — callers on the default path
+    never materialize a scale array, the bit-exact early return).
+    'int8': symmetric per-row linear quantization; `sr=True` rounds
+    stochastically with the SAME keyless (lane, value-bits, salt) hash
+    as `stochastic_round_bf16` — the training write-back path, so the
+    quantization error of repeated updates centers on zero across
+    values instead of accumulating RNE bias. 'fp8': e4m3 cast after the
+    per-row rescale (e4m3's own RNE; SR is int8-only — 3 mantissa bits
+    leave no headroom for the hash trick)."""
+    store_dtype = resolve_store_dtype(store_dtype)
+    if store_dtype == "f32":
+        return rows, None
+    rows = rows.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(rows), axis=-1, keepdims=True)
+    if store_dtype == "int8":
+        scale = _row_scale(amax, INT8_AMAX)
+        y = rows / scale
+        if sr:
+            bits = lax.bitcast_convert_type(y, jnp.uint32)
+            idx = lax.iota(jnp.uint32, y.size).reshape(y.shape)
+            h = bits ^ (idx * jnp.uint32(2654435761) + jnp.uint32(salt))
+            h = (h ^ (h >> 15)) * jnp.uint32(0x2C1B3C6D)
+            h = (h ^ (h >> 12)) * jnp.uint32(0x297A2D39)
+            h = h ^ (h >> 15)
+            u = (h & jnp.uint32(0xFFFF)).astype(jnp.float32) / 65536.0
+            q = jnp.floor(y + u)
+        else:
+            q = jnp.rint(y)
+        payload = jnp.clip(q, -INT8_AMAX, INT8_AMAX).astype(jnp.int8)
+        return payload, scale
+    scale = _row_scale(amax, FP8_AMAX)
+    payload = (rows / scale).astype(jnp.float8_e4m3fn)
+    return payload, scale
+
+
+def decode_rows(payload: jax.Array, scale, store_dtype: str) -> jax.Array:
+    """(payload, scale) -> f32 rows; the gather-time decode. 'f32' is
+    the identity."""
+    if resolve_store_dtype(store_dtype) == "f32":
+        return payload
+    return payload.astype(jnp.float32) * scale
+
+
+def encode_rows_np(rows, store_dtype: str):
+    """Host-side (numpy) twin of `encode_rows` for stream/stash
+    payloads — always RNE (published bytes must be deterministic and
+    reproducible; SR is the training write-back's tool)."""
+    import numpy as np
+    store_dtype = resolve_store_dtype(store_dtype)
+    rows = np.asarray(rows, np.float32)
+    if store_dtype == "f32":
+        return rows, None
+    amax = np.max(np.abs(rows), axis=-1, keepdims=True) \
+        if rows.size else np.zeros(rows.shape[:-1] + (1,), np.float32)
+    if store_dtype == "int8":
+        scale = np.where(amax > 0, amax / INT8_AMAX, 1.0).astype(np.float32)
+        with np.errstate(invalid="ignore"):
+            q = np.rint(rows / scale)
+        payload = np.clip(q, -INT8_AMAX, INT8_AMAX).astype(np.int8)
+        return payload, scale
+    import ml_dtypes
+    scale = np.where(amax > 0, amax / FP8_AMAX, 1.0).astype(np.float32)
+    payload = (rows / scale).astype(ml_dtypes.float8_e4m3fn)
+    return payload, scale
+
+
+def decode_rows_np(payload, scale, store_dtype: str):
+    import numpy as np
+    if resolve_store_dtype(store_dtype) == "f32":
+        return np.asarray(payload, np.float32)
+    payload = np.asarray(payload)
+    if store_dtype == "fp8":
+        import ml_dtypes
+        if payload.dtype != np.dtype(ml_dtypes.float8_e4m3fn):
+            # .npz containers round-trip the custom float8 dtype as raw
+            # 1-byte void — same bits, lost descriptor; view it back
+            payload = payload.view(ml_dtypes.float8_e4m3fn)
+    return payload.astype(np.float32) * np.asarray(scale, np.float32)
 
 
 # ------------------------------------------------------------- encoders
@@ -457,3 +669,17 @@ def seam_id_dtypes(id_wire: str):
     if id_wire == "int32":
         return ("i32",)
     return ("i16", "i32")
+
+
+def seam_storage_dtypes(store_dtype: str):
+    """StableHLO element types a bucket's at-rest storage at
+    `store_dtype` may put in a lowered program ('f32' declares NOTHING
+    quantized: an i8/f8 buffer in an all-f32-storage program is a seam
+    escape the storage-dtype pass flags). Read by analysis/passes.py
+    off this module so the audit and the codec cannot drift."""
+    store_dtype = resolve_store_dtype(store_dtype)
+    if store_dtype == "int8":
+        return ("i8",)
+    if store_dtype == "fp8":
+        return ("f8E4M3FN",)
+    return ()

@@ -80,6 +80,13 @@ class TPBucket:
     # bit for bit.
     wire_dtype: str = "f32"
     id_wire_dtype: str = "int32"
+    # at-rest row storage dtype (ISSUE 15): 'f32' (default — arrays are
+    # byte-identical to pre-seam params), 'int8'/'fp8' (quantized
+    # payload + per-row f32 scale, decoded at gather time). Set by
+    # lower_strategy from the planner's storage_dtype request, gated
+    # per bucket (see _storage_eligibility): only cold/offloaded
+    # buckets quantize — the HBM hot path keeps exact rows.
+    storage_dtype: str = "f32"
     # dynamic-vocabulary slack (ISSUE 7): pre-reserved growth rows
     # folded into this bucket's rows_max (max over ranks of the summed
     # per-table vocab_slack placed on that rank). Informational — the
@@ -107,6 +114,11 @@ class RowTablePlan:
     # gradient transposes, `id_wire_dtype` the id all_gather.
     wire_dtype: str = "f32"
     id_wire_dtype: str = "int32"
+    # at-rest storage (ISSUE 15): row-sliced tables are device-resident
+    # HBM shards on the training hot path — always 'f32' under the
+    # cold-rows-only gate; the field exists so every byte report reads
+    # ONE schema across table kinds.
+    storage_dtype: str = "f32"
 
 
 @dataclasses.dataclass
@@ -170,6 +182,23 @@ def _wire_eligibility(combiner: Optional[str], offload: bool,
         compress, and marking them f32 keeps the report honest.
     """
     if combiner is None or offload:
+        return "f32"
+    return requested
+
+
+def _storage_eligibility(offload: bool, requested: str) -> str:
+    """At-rest storage dtype for one bucket, 'f32' when ineligible.
+
+    Only COLD (host-offloaded) buckets quantize: they are the capacity
+    bottleneck the codec exists for (~4x more rows per host byte, ~4x
+    fewer bytes per host<->device row move), their lookups already run
+    through one seam (`_host_group_exchange`) where the decode folds
+    into the gather, and their sparse apply runs out-of-jit where the
+    SR re-encode is a host-side epilogue. Device-resident buckets stay
+    f32: the HBM training hot path reads rows every step, and rounding
+    EVERY gather/update there is a different (master-weight) design —
+    ROADMAP item 2's stretch goal, not this seam."""
+    if not offload:
         return "f32"
     return requested
 
@@ -268,6 +297,7 @@ def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
 
     from distributed_embeddings_tpu.ops.wire import default_id_wire
     requested_wire = getattr(strategy, "exchange_wire", "f32")
+    requested_store = getattr(strategy, "storage_dtype", "f32")
     id_wire_mode = default_id_wire()
     for bucket in buckets:
         bucket.f_max = max((len(s) for s in bucket.slots), default=0)
@@ -276,6 +306,8 @@ def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
         bucket.wire_dtype = _wire_eligibility(
             bucket.combiner, bucket.offload, requested_wire)
         bucket.id_wire_dtype = _id_wire_dtype(bucket.rows_max, id_wire_mode)
+        bucket.storage_dtype = _storage_eligibility(bucket.offload,
+                                                    requested_store)
 
     # ---------------- row-sliced tables -------------------------------------
     row_tables: List[RowTablePlan] = []

@@ -25,7 +25,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 # ops/wire.py — the planner only records the REQUEST (validated against
 # that one registry), and lowering (parallel/plan.py) gates it per bucket
 from distributed_embeddings_tpu.ops.wire import (
-    WIRE_FORMATS as EXCHANGE_WIRE_FORMATS, default_exchange_wire)
+    WIRE_FORMATS as EXCHANGE_WIRE_FORMATS, default_exchange_wire,
+    default_store_dtype, resolve_store_dtype)
 from distributed_embeddings_tpu.utils.initializers import ConcatInitializer
 
 Config = Dict[str, Any]
@@ -89,7 +90,8 @@ class DistEmbeddingStrategy:
                  input_hotness: Optional[Sequence[Optional[int]]] = None,
                  hot_rows: Optional[int] = None,
                  exchange_wire: Optional[str] = None,
-                 vocab_slack: Optional[int] = None):
+                 vocab_slack: Optional[int] = None,
+                 storage_dtype: Optional[str] = None):
         if strategy not in ("auto", "basic", "memory_balanced",
                             "memory_optimized", "comm_balanced"):
             raise ValueError(f"Unsupported shard strategy {strategy}")
@@ -126,6 +128,12 @@ class DistEmbeddingStrategy:
                 f"exchange_wire={exchange_wire!r}: expected one of "
                 f"{EXCHANGE_WIRE_FORMATS}")
         self.exchange_wire = exchange_wire
+        # at-rest row storage request (ISSUE 15); None defers to the
+        # DET_STORE_DTYPE environment default. Per-bucket eligibility
+        # (only cold/offloaded buckets quantize — hot HBM shards stay
+        # f32) is decided at lowering time, like exchange_wire above.
+        self.storage_dtype = (default_store_dtype() if storage_dtype is None
+                              else resolve_store_dtype(storage_dtype))
 
         self.global_configs = []
         for emb in embeddings:

@@ -83,6 +83,17 @@ class HotRowCache:
             raise ValueError(
                 f"bucket {bucket} is not host-offloaded; a hot-row cache "
                 "only makes sense over a host-resident table")
+        if bk.storage_dtype != "f32":
+            # quantized at-rest storage (ISSUE 15): every cache read path
+            # that touches the raw table (`admit`/`refresh`/the miss-lane
+            # gather in `cached_group_lookup`) assumes f32 rows; serving
+            # a quantized bucket falls back to the stock decode-at-gather
+            # host lookup until the cache grows the decode seam
+            raise ValueError(
+                f"bucket {bucket} stores {bk.storage_dtype} rows: the HBM "
+                "hot-row cache reads raw f32 table rows and does not yet "
+                "decode quantized storage — serve this bucket through the "
+                "stock offloaded lookup (it decodes at gather time)")
         self.emb = emb
         self.bucket = bucket
         self.capacity = int(capacity)

@@ -158,6 +158,18 @@ class InferenceEngine:
             else:
                 caps = {b: int(cache_capacity) for b in off}
             for b, cap in caps.items():
+                if cap > 0 and emb._bucket_store_dtype(b) != "f32":
+                    # quantized bucket (ISSUE 15): the cache has no
+                    # decode seam yet — serve through the stock
+                    # decode-at-gather lookup instead of refusing the
+                    # whole engine
+                    import warnings
+                    warnings.warn(
+                        f"serving cache skipped for bucket {b}: it "
+                        f"stores {emb._bucket_store_dtype(b)} rows; "
+                        "requests fall back to the decoded host lookup",
+                        RuntimeWarning, stacklevel=2)
+                    continue
                 if cap > 0:
                     self.caches[b] = HotRowCache(
                         emb, b, cap, promote_threshold=promote_threshold)

@@ -71,6 +71,7 @@ import jax.numpy as jnp
 from distributed_embeddings_tpu import faults
 from distributed_embeddings_tpu.obs.trace import default_recorder
 from distributed_embeddings_tpu.ops import sparse_update as sparse_update_ops
+from distributed_embeddings_tpu.ops import wire as wire_ops
 from distributed_embeddings_tpu.utils import checkpoint as ckpt_lib
 
 __all__ = ["DeltaChainError", "DeltaConsumer", "TableStore",
@@ -161,7 +162,10 @@ def padded_scatter_rows(arr, w_idx: np.ndarray, r_idx: np.ndarray,
 def _np_rows_from_shards(arr, w_idx: np.ndarray,
                          r_idx: np.ndarray) -> np.ndarray:
     """Row gather from a (host-resident) stacked array via its
-    addressable shards — no XLA program touches the host placement."""
+    addressable shards — no XLA program touches the host placement.
+    Output is f32 VALUES regardless of the stored dtype (int8/fp8
+    payloads cast losslessly; the caller multiplies in the per-row
+    scale for quantized buckets)."""
     out = np.zeros((len(w_idx), arr.shape[-1]), np.float32)
     for sh in arr.addressable_shards:
         start = sh.index[0].start or 0
@@ -195,11 +199,17 @@ def _host_set_rows(table_h, w_idx: np.ndarray, r_idx: np.ndarray,
             m = w_idx == start + j
             if m.any():
                 n = int(m.sum())
-                sparse_update_ops.host_apply_rows_inplace(
-                    "set", t_np[j], (),
-                    np.ascontiguousarray(r_idx[m], np.int32),
-                    np.ascontiguousarray(rows[m], np.float32),
-                    np.ones((n,), np.float32), 0.0)
+                if t_np.dtype == np.float32:
+                    sparse_update_ops.host_apply_rows_inplace(
+                        "set", t_np[j], (),
+                        np.ascontiguousarray(r_idx[m], np.int32),
+                        np.ascontiguousarray(rows[m], np.float32),
+                        np.ones((n,), np.float32), 0.0)
+                else:
+                    # quantized payload/scale leaves (ISSUE 15): the
+                    # C++ row kernels are f32-only; a plain fancy-index
+                    # set is the same rows-only write at these dtypes
+                    t_np[j][r_idx[m]] = np.asarray(rows[m], t_np.dtype)
         new_shards.append(jax.device_put(t_np, sh.data.sharding))
     return jax.make_array_from_single_device_arrays(
         table_h.shape, table_h.sharding, new_shards)
@@ -238,6 +248,14 @@ class TableStore:
       snapshot_every: every N-th publish is a full-snapshot compaction
         (0/None = only the mandatory first publish; env default
         `DET_STORE_SNAPSHOT_EVERY`).
+      delta_dtype: payload dtype of published stream files (ISSUE 15):
+        'f32' (default — byte-identical files to the pre-seam
+        container), 'int8' or 'fp8' (per-row-scaled quantized row
+        payloads, ~4x smaller; the container header carries the dtype
+        and consumers decode on apply). None defers to
+        ``DET_DELTA_DTYPE``. Keys stay int64 and dp tables stay f32
+        (dense-trained and small by construction). Applies to what THIS
+        store publishes; consuming is driven by each file's header.
       registry: optional `obs.MetricRegistry` (ISSUE 11) the store's
         streaming metrics land in — producer counters
         (``store/publishes``, ``store/publish_bytes``,
@@ -252,7 +270,8 @@ class TableStore:
     """
 
     def __init__(self, emb, params: dict, opt_states: Optional[dict] = None,
-                 snapshot_every: Optional[int] = None, registry=None):
+                 snapshot_every: Optional[int] = None, registry=None,
+                 delta_dtype: Optional[str] = None):
         from distributed_embeddings_tpu.obs.registry import MetricRegistry
         self._metrics = registry if registry is not None \
             else MetricRegistry()
@@ -263,6 +282,12 @@ class TableStore:
             snapshot_every = int(os.environ.get(
                 "DET_STORE_SNAPSHOT_EVERY", "0"))
         self.snapshot_every = int(snapshot_every)
+        self.delta_dtype = (wire_ops.default_delta_dtype()
+                            if delta_dtype is None
+                            else wire_ops.resolve_store_dtype(delta_dtype))
+        # cumulative published bytes per payload dtype -> the
+        # ``store/bytes{dtype=}`` gauge (docs/observability.md)
+        self._published_bytes_by_dtype: Dict[str, int] = {}
         self.version = 0
         strat = emb.strategy
         self._n_tables = len(strat.global_configs)
@@ -423,6 +448,13 @@ class TableStore:
         r_idx = keys % rows_max
         if self.emb._bucket_memory_kind(b):
             out = _np_rows_from_shards(arr, w_idx, r_idx)
+            sd = self.emb._bucket_store_dtype(b)
+            if sd != "f32":
+                # quantized at-rest storage (ISSUE 15): the versioned
+                # read is ALWAYS decoded f32 — payload values (cast
+                # losslessly above) x the per-row scale leaf
+                out = out * _np_rows_from_shards(
+                    self._params["tp_scale"][b], w_idx, r_idx)
         else:
             out = padded_gather_rows(arr, w_idx, r_idx)
         overlay = self.emb.hot_resident_rows(self._params).get(b)
@@ -526,15 +558,35 @@ class TableStore:
         snap = (force_snapshot or self._published_version is None
                 or (self.snapshot_every
                     and publishes % self.snapshot_every == 0))
+        dd = self.delta_dtype
         meta = {"version": self.version,
                 "base_version": self._published_version,
                 "published_at": time.time(),
+                "dtype": dd,
                 "sig": self._sig}
+
+        def enc(arrays, name, rows):
+            # quantized stream payload (ISSUE 15): rows encode at the
+            # store's delta_dtype with the per-row scale as a sibling
+            # array; f32 writes the rows verbatim (byte-identical file)
+            p, s = wire_ops.encode_rows_np(rows, dd)
+            arrays[name] = p
+            if s is not None:
+                arrays[f"{name}_scale"] = s
+
+        # model payload bytes through the ONE shared formula
+        # (ops/wire.delta_row_bytes / snapshot_row_bytes) — the bench's
+        # measured-vs-model reconciliation and `exchange_padding_report`
+        # charge the same arithmetic
+        model_bytes = 0
         if snap:
             meta["kind"] = "snapshot"
             weights = self.get_weights()
-            arrays = {f"table{i}": np.asarray(w, np.float32)
-                      for i, w in enumerate(weights)}
+            arrays = {}
+            for i, w in enumerate(weights):
+                enc(arrays, f"table{i}", np.asarray(w, np.float32))
+                model_bytes += w.shape[0] * wire_ops.snapshot_row_bytes(
+                    w.shape[1], dd)
             n_rows = sum(w.shape[0] for w in weights)
         else:
             meta["kind"] = "delta"
@@ -544,11 +596,16 @@ class TableStore:
                 rows = (self.read_rows(idx, keys) if kind == "tp"
                         else self.read_row_table_rows(idx, keys))
                 arrays[f"{kind}{idx}_keys"] = keys
-                arrays[f"{kind}{idx}_rows"] = rows
+                enc(arrays, f"{kind}{idx}_rows", rows)
+                model_bytes += len(keys) * wire_ops.delta_row_bytes(
+                    rows.shape[1], dd)
                 n_rows += len(keys)
             for j in range(len(self._params["dp"])):
+                # dp tables stay f32: dense-trained (every row moves
+                # every delta) and small by construction
                 dp = np.asarray(self._params["dp"][j], np.float32)
                 arrays[f"dp{j}_full"] = dp
+                model_bytes += dp.nbytes
                 n_rows += dp.shape[0]
         path = _publish_path(directory, self.version, meta["kind"])
         spec = faults.check("store.publish", path=path,
@@ -578,10 +635,21 @@ class TableStore:
         self._pending = {}
         info = {"kind": meta["kind"], "version": self.version,
                 "base_version": meta["base_version"], "path": path,
-                "bytes": os.path.getsize(path), "rows": n_rows}
+                "bytes": os.path.getsize(path), "rows": n_rows,
+                "dtype": dd,
+                # measured sum of in-file array bytes vs the shared byte
+                # model (wire.delta_row_bytes/snapshot_row_bytes) — equal
+                # by construction; the bench and tier-1 assert it stays so
+                "payload_bytes": int(sum(a.nbytes
+                                         for a in arrays.values())),
+                "model_payload_bytes": int(model_bytes)}
         m.counter("store/publishes").inc()
         m.counter("store/publish_bytes").inc(info["bytes"])
         m.counter("store/publish_rows").inc(n_rows)
+        self._published_bytes_by_dtype[dd] = (
+            self._published_bytes_by_dtype.get(dd, 0) + info["bytes"])
+        m.gauge("store/bytes", dtype=dd).set(
+            self._published_bytes_by_dtype[dd])
         # role-labeled: a publisher and a consumer store on ONE shared
         # run registry (the bench serve mode shape) must not flap a
         # single version gauge between the two meanings
@@ -608,15 +676,26 @@ class TableStore:
                 "consumers must sync_hot_rows + drop residency first)")
 
     def _apply_tp_rows(self, b: int, keys: np.ndarray, rows: np.ndarray):
+        """Set decoded f32 `rows` into bucket b. Returns (table, scale):
+        scale is None for f32-stored buckets; quantized buckets (ISSUE
+        15) re-encode the incoming rows at the bucket's storage dtype
+        (deterministic RNE — stream application must be reproducible)
+        and write payload + per-row scale leaves in one pass."""
         bucket = self.emb.plan.tp_buckets[b]
         rows_max = max(bucket.rows_max, 1)
         arr = self._params["tp"][b]
         w_idx = keys // rows_max
         r_idx = keys % rows_max
+        sd = self.emb._bucket_store_dtype(b)
+        if sd != "f32":
+            payload, scale = wire_ops.encode_rows_np(rows, sd)
+            return (_host_set_rows(arr, w_idx, r_idx, payload),
+                    _host_set_rows(self._params["tp_scale"][b],
+                                   w_idx, r_idx, scale))
         if self.emb._bucket_memory_kind(b):
             return _host_set_rows(arr, w_idx, r_idx,
-                                  np.asarray(rows, np.float32))
-        return padded_scatter_rows(arr, w_idx, r_idx, rows)
+                                  np.asarray(rows, np.float32)), None
+        return padded_scatter_rows(arr, w_idx, r_idx, rows), None
 
     def _apply_row_rows(self, t: int, keys: np.ndarray, rows: np.ndarray):
         rt = self.emb.plan.row_tables[t]
@@ -641,9 +720,28 @@ class TableStore:
             # counted — the rolling-upgrade signal (ISSUE 13)
             self._metrics.counter("store/legacy_files_total").inc()
         self._check_sig(meta, path)
+        # payload dtype (ISSUE 15): legacy headers carry none and load
+        # as the f32 they are; quantized payloads decode against their
+        # `_scale` siblings here, so every downstream consumer (row
+        # scatter, HBM caches, the returned payload map) sees f32 rows.
+        # load_row_delta already refused dtypes this build cannot decode.
+        stream_dtype = meta.get("dtype", "f32")
+
+        def dec(name):
+            if stream_dtype == "f32":
+                return np.asarray(arrays[name], np.float32)
+            scale = arrays.get(f"{name}_scale")
+            if scale is None:
+                raise ValueError(
+                    f"{path}: array {name} is {stream_dtype}-encoded but "
+                    "carries no _scale sibling — publisher bug, not "
+                    "stream damage")
+            return wire_ops.decode_rows_np(arrays[name], scale,
+                                           stream_dtype)
+
         payload: Dict[Tuple[str, int], Tuple[np.ndarray, np.ndarray]] = {}
         if meta["kind"] == "snapshot":
-            tables = [arrays[f"table{i}"] for i in range(self._n_tables)]
+            tables = [dec(f"table{i}") for i in range(self._n_tables)]
             self._params = self.emb.set_weights(tables)
             n_rows = sum(t.shape[0] for t in tables)
             self._chain_broken = False       # snapshots re-anchor the chain
@@ -664,6 +762,8 @@ class TableStore:
             new_params["tp"] = list(self._params["tp"])
             new_params["row"] = list(self._params["row"])
             new_params["dp"] = list(self._params["dp"])
+            if "tp_scale" in self._params:
+                new_params["tp_scale"] = list(self._params["tp_scale"])
             n_rows = 0
             for name in sorted(arrays):
                 m = re.match(r"^(tp|row)(\d+)_keys$", name)
@@ -671,11 +771,13 @@ class TableStore:
                     continue
                 kind, idx = m.group(1), int(m.group(2))
                 keys = np.asarray(arrays[name], np.int64)
-                rows = np.asarray(arrays[f"{kind}{idx}_rows"], np.float32)
+                rows = dec(f"{kind}{idx}_rows")
                 n_rows += len(keys)
                 if kind == "tp":
-                    new_params["tp"][idx] = self._apply_tp_rows(
+                    new_params["tp"][idx], scale_leaf = self._apply_tp_rows(
                         idx, keys, rows)
+                    if scale_leaf is not None:
+                        new_params["tp_scale"][idx] = scale_leaf
                     payload[("tp", idx)] = (keys, rows)
                 else:
                     new_params["row"][idx] = self._apply_row_rows(
@@ -1004,8 +1106,21 @@ def restore_from_published(emb, directory: str,
     _, _, snap_path = snaps[-1]
     meta, arrays = ckpt_lib.load_row_delta(snap_path)
     n = len(meta["sig"])
-    store = TableStore(emb, emb.set_weights(
-        [arrays[f"table{i}"] for i in range(n)]))
+    sd = meta.get("dtype", "f32")
+
+    def table(i):
+        if sd == "f32":
+            return arrays[f"table{i}"]
+        scale = arrays.get(f"table{i}_scale")
+        if scale is None:
+            # same publisher-bug guard as apply_published's dec()
+            raise ValueError(
+                f"{snap_path}: array table{i} is {sd}-encoded but "
+                "carries no _scale sibling — publisher bug, not "
+                "stream damage")
+        return wire_ops.decode_rows_np(arrays[f"table{i}"], scale, sd)
+
+    store = TableStore(emb, emb.set_weights([table(i) for i in range(n)]))
     store._check_sig(meta, snap_path)
     store.version = int(meta["version"])
     for version, kind, path in files:

@@ -343,14 +343,25 @@ def make_sparse_train_step(model, optimizer: str = "adagrad", lr=0.01,
             params, opt_state, numerical, cats, labels)
         tp = list(new_params["embedding"]["tp"])
         tp_s = list(new_state["emb"]["tp"])
+        scales = new_params["embedding"].get("tp_scale")
+        tp_scale = list(scales) if scales is not None else None
         for b, pend in pending.items():
             rep, sums, valid = pend[0], pend[1], pend[2]
             lr_t = pend[3] if len(pend) > 3 else None
-            tp[b], tp_s[b] = emb.host_bucket_apply(
+            scale_b = (tp_scale[b] if tp_scale is not None else None)
+            out = emb.host_bucket_apply(
                 b, params["embedding"]["tp"][b], opt_state["emb"]["tp"][b],
-                rep, sums, valid, sopt, lr_value=lr_t)
-        new_params = {**new_params,
-                      "embedding": {**new_params["embedding"], "tp": tp}}
+                rep, sums, valid, sopt, lr_value=lr_t, scale_h=scale_b)
+            if scale_b is not None:
+                # quantized storage (ISSUE 15): the SR write-back
+                # refreshed both the payload and the per-row scales
+                tp[b], tp_scale[b], tp_s[b] = out
+            else:
+                tp[b], tp_s[b] = out
+        new_emb = {**new_params["embedding"], "tp": tp}
+        if tp_scale is not None:
+            new_emb["tp_scale"] = tp_scale
+        new_params = {**new_params, "embedding": new_emb}
         new_state = {**new_state, "emb": {**new_state["emb"], "tp": tp_s}}
         return new_params, new_state, loss
 

@@ -61,9 +61,37 @@ __all__ = [
     "publish_atomic",
     "sweep_orphan_tmp",
     "STREAM_CONTAINER_VERSION",
+    "STREAM_PAYLOAD_DTYPES",
 ]
 
 # ---------------------------------------------------------------- container
+# payload dtypes the stream container can declare (ISSUE 15) — mirrors
+# ops/wire.STORE_DTYPES without importing jax at checkpoint-import time;
+# tests pin the two registries equal so they cannot drift
+STREAM_PAYLOAD_DTYPES = ("f32", "int8", "fp8")
+
+
+def _check_payload_dtype(meta: dict, path: str) -> None:
+    """Refuse a payload dtype this consumer does not support — a CONFIG
+    error (ValueError), never `StreamIntegrityError`: the file is
+    healthy, the fleet is mismatched (e.g. an int4 publisher ahead of
+    this build, or an fp8 stream on a backend without float8). Damage
+    classification (quarantine) must not eat it."""
+    dtype = meta.get("dtype", "f32")
+    if dtype not in STREAM_PAYLOAD_DTYPES:
+        raise ValueError(
+            f"{path}: stream payload dtype {dtype!r} is not supported by "
+            f"this consumer (supported: {STREAM_PAYLOAD_DTYPES}); upgrade "
+            "the consumer or republish at a supported dtype")
+    if dtype == "fp8":
+        from distributed_embeddings_tpu.ops.wire import fp8_supported
+        if not fp8_supported():
+            raise ValueError(
+                f"{path}: stream payload is fp8 but this backend ships "
+                "no float8_e4m3fn — republish at int8/f32 or upgrade "
+                "the consumer's toolchain")
+
+
 # Stream-file container version (ISSUE 13). v2 adds integrity checksums:
 # a per-array crc32 table plus a crc over the canonicalized metadata
 # header itself, both verified on load. v1 (checksum-less) files still
@@ -314,10 +342,24 @@ def save_row_delta(path: str, meta: dict, arrays: Dict[str, np.ndarray]
     per-member CRC catches most in-file damage at read time — this
     layer exists for what it cannot: header/payload cross-consistency,
     damage applied after extraction, and a versioned, self-describing
-    on-disk contract."""
+    on-disk contract.
+
+    Payload dtype (ISSUE 15): the header's ``dtype`` field declares how
+    row payloads are stored — 'f32' (stamped here when the caller set
+    none, so every file is self-describing), or 'int8'/'fp8' (each
+    ``*_rows``/``table{i}`` array quantized with a ``*_scale`` f32
+    sibling; dp tables stay f32). Consumers REFUSE a dtype they cannot
+    decode at load time — loudly, as the config error it is
+    (`StreamIntegrityError` stays reserved for damage)."""
     if not path.endswith(".npz"):
         path = path + ".npz"
     meta = dict(meta)
+    meta.setdefault("dtype", "f32")
+    if meta["dtype"] not in STREAM_PAYLOAD_DTYPES:
+        raise ValueError(
+            f"save_row_delta: payload dtype {meta['dtype']!r} is not a "
+            f"stream container dtype (expected one of "
+            f"{STREAM_PAYLOAD_DTYPES})")
     meta["container"] = STREAM_CONTAINER_VERSION
     meta["crc"] = {name: _array_crc(arr) for name, arr in arrays.items()}
     meta["header_crc"] = _header_crc(meta)
@@ -356,6 +398,10 @@ def load_row_delta(path: str, verify: bool = True
         raise StreamIntegrityError(
             f"{path}: unreadable stream container "
             f"({type(e).__name__}: {e})") from e
+    # dtype refusal OUTSIDE the damage classification (ISSUE 15): an
+    # unsupported payload dtype is a config error and must propagate as
+    # ValueError, never quarantine a healthy stream
+    _check_payload_dtype(meta, path)
     if verify:
         verify_stream_payload(meta, arrays, path=path)
     return meta, arrays
@@ -382,6 +428,7 @@ def load_row_delta_meta(path: str, verify: bool = True) -> dict:
             and _header_crc(meta) != int(meta["header_crc"]):
         raise StreamIntegrityError(
             f"{path}: metadata header checksum mismatch")
+    _check_payload_dtype(meta, path)
     return meta
 
 

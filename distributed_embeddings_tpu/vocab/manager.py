@@ -58,6 +58,7 @@ import numpy as np
 import jax
 
 from distributed_embeddings_tpu.layers.embedding import IntegerLookup
+from distributed_embeddings_tpu.ops import wire as wire_ops
 from distributed_embeddings_tpu.ops.embedding_ops import RaggedIds, SparseIds
 # one implementation of the pow2-padded cached row scatter/gather
 # (out-of-range world index drops) — shared with the table store so the
@@ -129,7 +130,9 @@ class ManagedVocab:
     def __init__(self, table_id: int, capacity: int, base_rows: int,
                  slack: int, admit_threshold: int, decay: float,
                  use_native: Optional[bool] = None,
-                 stash_max: Optional[int] = None):
+                 stash_max: Optional[int] = None,
+                 stash_dtype: Optional[str] = None,
+                 stash_max_bytes: Optional[int] = None):
         if capacity < 2:
             raise ValueError(
                 f"managed table {table_id}: capacity {capacity} leaves no "
@@ -168,6 +171,21 @@ class ManagedVocab:
         self.stash: Dict[int, np.ndarray] = {}
         self.stash_max = (capacity - 1 if stash_max is None
                           else max(0, int(stash_max)))
+        # quantized stash storage (ISSUE 15): evicted rows park at
+        # `stash_dtype` (int8/fp8 payload + one f32 scale per row —
+        # ~4x more evicted tenants resident per stash byte; re-admission
+        # decodes, so the restore differs from the demoted row by at
+        # most one quantization step). None defers to DET_STORE_DTYPE;
+        # 'f32' keeps the exact pre-seam stash. `stash_max_bytes`
+        # optionally bounds the stash in BYTES (oldest demotion drops
+        # first, like the row cap) — the budget under which a quantized
+        # stash holds ~4x more tenants.
+        self.stash_dtype = wire_ops.resolve_store_dtype(
+            wire_ops.default_store_dtype() if stash_dtype is None
+            else stash_dtype)
+        self.stash_max_bytes = (None if stash_max_bytes is None
+                                else max(0, int(stash_max_bytes)))
+        self._stash_bytes = 0
         # lifetime stats
         self.admissions = 0
         self.evictions = 0
@@ -242,18 +260,63 @@ class ManagedVocab:
         order = np.argsort(scores, kind="stable")      # coldest first
         return keys[order[:n_evict]]
 
+    # --------------------------------------------------- stash internals
+    @staticmethod
+    def _entry_bytes(entry) -> int:
+        """Resident bytes of one stash entry: the 8-byte key + payload
+        (+ the per-row scale for quantized entries)."""
+        if isinstance(entry, tuple):
+            return 8 + entry[0].nbytes + 4
+        return 8 + entry.nbytes
+
+    def _stash_put(self, key: int, row_f32: np.ndarray) -> None:
+        """Insert one demoted row (f32 in, stored at `stash_dtype`) and
+        keep both stash bounds: the row cap and the optional byte
+        budget, oldest demotion first."""
+        old = self.stash.pop(key, None)        # re-stash refreshes age
+        if old is not None:
+            self._stash_bytes -= self._entry_bytes(old)
+        if self.stash_dtype == "f32":
+            entry = np.asarray(row_f32, np.float32)
+        else:
+            p, s = wire_ops.encode_rows_np(
+                np.asarray(row_f32, np.float32)[None], self.stash_dtype)
+            entry = (p[0], np.float32(s[0, 0]))
+        self.stash[key] = entry
+        self._stash_bytes += self._entry_bytes(entry)
+        while self.stash and (
+                len(self.stash) > self.stash_max
+                or (self.stash_max_bytes is not None
+                    and self._stash_bytes > self.stash_max_bytes)):
+            dropped = self.stash.pop(next(iter(self.stash)))
+            self._stash_bytes -= self._entry_bytes(dropped)
+
+    def stash_take(self, key: int) -> Optional[np.ndarray]:
+        """Pop + decode one stashed row (f32), or None."""
+        entry = self.stash.pop(int(key), None)
+        if entry is None:
+            return None
+        self._stash_bytes -= self._entry_bytes(entry)
+        if isinstance(entry, tuple):
+            return wire_ops.decode_rows_np(
+                entry[0], np.asarray(entry[1]).reshape(1),
+                self.stash_dtype)
+        return entry
+
+    def stash_bytes(self) -> int:
+        """Resident stash bytes (keys + payloads + scales) — the
+        ``vocab/stash_bytes`` gauge's per-table term."""
+        return self._stash_bytes
+
     def unbind(self, keys: np.ndarray,
                rows_payload: Optional[np.ndarray] = None) -> np.ndarray:
         """Erase bindings (eviction). `rows_payload` ([n, width]) is the
-        keys' current embedding rows — stashed for re-admission. Returns
-        the freed row indices."""
+        keys' current embedding rows — stashed (at `stash_dtype`) for
+        re-admission. Returns the freed row indices."""
         keys = np.asarray(keys, np.int64).reshape(-1)
         if rows_payload is not None:
             for i, k in enumerate(keys.tolist()):
-                self.stash.pop(k, None)        # re-stash refreshes age
-                self.stash[k] = np.asarray(rows_payload[i], np.float32)
-            while len(self.stash) > self.stash_max:
-                self.stash.pop(next(iter(self.stash)))
+                self._stash_put(k, rows_payload[i])
         freed = self.binding.erase(keys)
         self.evictions += int((np.asarray(freed) != 0).sum())
         return freed
@@ -273,12 +336,27 @@ class ManagedVocab:
         if full:
             ck, cv = self._tracker_items()
             stash_keys = np.asarray(sorted(self.stash), np.int64)
-            stash_rows = (np.stack([self.stash[int(k)]
-                                    for k in stash_keys])
-                          if len(stash_keys)
-                          else np.zeros((0, 0), np.float32))
-            out.update({"count_keys": ck, "count_vals": cv,
-                        "stash_keys": stash_keys, "stash_rows": stash_rows})
+            if self.stash_dtype == "f32":
+                stash_rows = (np.stack([self.stash[int(k)]
+                                        for k in stash_keys])
+                              if len(stash_keys)
+                              else np.zeros((0, 0), np.float32))
+                out.update({"count_keys": ck, "count_vals": cv,
+                            "stash_keys": stash_keys,
+                            "stash_rows": stash_rows})
+            else:
+                # quantized stash (ISSUE 15): checkpoint the payloads at
+                # rest — a table-sized stash must not inflate 4x through
+                # every save — with the per-row scales as a sibling
+                entries = [self.stash[int(k)] for k in stash_keys]
+                stash_rows = (np.stack([e[0] for e in entries])
+                              if entries else np.zeros((0, 0), np.int8))
+                stash_scale = np.asarray([e[1] for e in entries],
+                                         np.float32)
+                out.update({"count_keys": ck, "count_vals": cv,
+                            "stash_keys": stash_keys,
+                            "stash_rows": stash_rows,
+                            "stash_scale": stash_scale})
         return out
 
     def _tracker_items(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -290,7 +368,8 @@ class ManagedVocab:
         cv = np.asarray([float(v) * inv for _, v in items], np.float64)
         return ck, cv
 
-    def load_state(self, state: Dict[str, np.ndarray]) -> None:
+    def load_state(self, state: Dict[str, np.ndarray],
+                   stash_dtype: str = "f32") -> None:
         """Rebuild binding/free-list/counters exactly from `state_dict`
         output. The index table is replayed in index order with
         placeholder keys in the holes; erasing the placeholders in the
@@ -337,11 +416,18 @@ class ManagedVocab:
             hot = cv >= self.tracker.promote_threshold
             self.tracker._pending = {int(k) for k in ck[unbound & hot]}
         self.stash = {}
+        self._stash_bytes = 0
         sk = np.asarray(state.get("stash_keys", []), np.int64)
-        sr = np.asarray(state.get("stash_rows",
-                                  np.zeros((0, 0))), np.float32)
+        sr = np.asarray(state.get("stash_rows", np.zeros((0, 0))))
+        # saved entries decode at the SAVED stash dtype, then re-park at
+        # this manager's configured dtype (legacy f32 files carry none)
+        if wire_ops.resolve_store_dtype(stash_dtype) != "f32":
+            sr = wire_ops.decode_rows_np(
+                sr, np.asarray(state["stash_scale"],
+                               np.float32)[:, None], stash_dtype)
+        sr = np.asarray(sr, np.float32)
         for i, k in enumerate(sk.tolist()):
-            self.stash[k] = sr[i]
+            self._stash_put(k, sr[i])
 
     def stats(self) -> dict:
         return {"capacity": self.capacity, "base_rows": self.base_rows,
@@ -353,7 +439,9 @@ class ManagedVocab:
                 "fallback_hit_rate": round(
                     self.fallback_hits / self.translated, 4)
                 if self.translated else 0.0,
-                "stashed": len(self.stash)}
+                "stashed": len(self.stash),
+                "stash_bytes": self.stash_bytes(),
+                "stash_dtype": self.stash_dtype}
 
 
 class VocabManager:
@@ -383,6 +471,14 @@ class VocabManager:
       stash_max: per-table bound on the host-side demotion stash
         (None = one table's worth of rows); the oldest stashed demotion
         drops first, and a dropped key re-admits from zeros.
+      stash_dtype: at-rest storage of stashed rows (ISSUE 15): 'f32'
+        (exact, default via ``DET_STORE_DTYPE``) or 'int8'/'fp8'
+        (per-row-scaled quantized payloads — ~4x more evicted tenants
+        resident per stash byte; a re-admitted row restores within one
+        quantization step of its demoted value).
+      stash_max_bytes: optional per-table BYTE budget on the stash
+        (keys + payloads + scales; oldest drops first) — the budget a
+        quantized stash holds ~4x more tenants under.
       registry: optional `obs.MetricRegistry` (ISSUE 11) the manager's
         vocabulary metrics land in — ``vocab/admissions`` /
         ``vocab/evictions`` counters and the ``vocab/occupancy`` /
@@ -407,7 +503,9 @@ class VocabManager:
                  replan_watermark: float = 0.98, on_miss: str = "fallback",
                  max_admit_per_cycle: Optional[int] = None,
                  use_native: Optional[bool] = None,
-                 stash_max: Optional[int] = None, log_fn=None,
+                 stash_max: Optional[int] = None,
+                 stash_dtype: Optional[str] = None,
+                 stash_max_bytes: Optional[int] = None, log_fn=None,
                  registry=None):
         if not emb.dp_input:
             raise ValueError(
@@ -468,7 +566,8 @@ class VocabManager:
                 base_rows=int(cfg.get("vocab_base_rows", cap)),
                 slack=int(cfg.get("vocab_slack", 0)),
                 admit_threshold=self.admit_threshold,
-                decay=decay, use_native=use_native, stash_max=stash_max)
+                decay=decay, use_native=use_native, stash_max=stash_max,
+                stash_dtype=stash_dtype, stash_max_bytes=stash_max_bytes)
         if on_miss == "drop":
             for gtid in self.vocabs:
                 if strat.global_configs[gtid].get("combiner") is None:
@@ -527,6 +626,8 @@ class VocabManager:
         m.gauge("vocab/low_watermark").set(self.low_watermark)
         m.gauge("vocab/fallback_hit_rate").set(fb / tr if tr else 0.0)
         m.gauge("vocab/maintain_cycles").set(self.maintain_cycles)
+        m.gauge("vocab/stash_bytes").set(
+            sum(mv.stash_bytes() for mv in self.vocabs.values()))
         for gtid, mv in self.vocabs.items():
             m.gauge("vocab/occupancy", table=gtid).set(mv.occupancy)
 
@@ -687,7 +788,7 @@ class VocabManager:
                     for pl in self._placements[gtid])
         payload = np.zeros((len(keys), width), np.float32)
         for i, k in enumerate(keys.tolist()):
-            stashed = mv.stash.pop(int(k), None)
+            stashed = mv.stash_take(k)     # decoded f32 (ISSUE 15)
             if stashed is not None:
                 payload[i] = stashed
         new_tp = list(params["tp"])
@@ -801,6 +902,11 @@ class VocabManager:
                 "admit_threshold": self.admit_threshold,
                 "decay": (self.vocabs[min(self.vocabs)].tracker.decay
                           if self.vocabs else None),
+                # stash payload encoding of THIS save (ISSUE 15) — a
+                # loader decodes with it, then re-parks at its own
+                # configured dtype; legacy files carry none (= f32)
+                "stash_dtype": (self.vocabs[min(self.vocabs)].stash_dtype
+                                if self.vocabs else "f32"),
                 "capacity": {str(t): mv.capacity
                              for t, mv in self.vocabs.items()}}
         arrays = {}
@@ -866,7 +972,8 @@ class VocabManager:
                      for name, arr in arrays.items()
                      if name.startswith(prefix)}
             if state:
-                mv.load_state(state)
+                mv.load_state(state,
+                              stash_dtype=meta.get("stash_dtype", "f32"))
 
     # -------------------------------------------------------------- stats
     def occupancy(self) -> Dict[int, float]:

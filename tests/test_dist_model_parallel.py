@@ -131,6 +131,13 @@ def check_equivalence(specs, world=8, input_table_map=None, inputs=None,
                                 input_table_map=input_table_map,
                                 input_max_hotness=input_max_hotness,
                                 **dist_kwargs)
+    if check_train and getattr(dist, "quantized_buckets", []):
+        # quantized (int8/fp8) offloaded buckets have non-differentiable
+        # table leaves: the dense-grad SGD comparison below cannot run,
+        # and the SUPPORTED training path for them is the tapped sparse
+        # step — its per-optimizer parity matrix lives in
+        # test_store_dtype.py. Forward equivalence still checks here.
+        check_train = False
     if vocab_axis:
         from distributed_embeddings_tpu.vocab import VocabManager
 

@@ -239,6 +239,49 @@ def test_touched_rows_per_step_schema():
             g["touched_rows_per_step"] * (8 + 4 * bucket.width))
 
 
+def test_delta_bytes_storage_dtype_aware():
+    """ISSUE 15 satellite: `delta_bytes_per_step` charges the STREAM's
+    storage dtype through the ONE shared formula
+    (`wire.delta_row_bytes` — 8 key bytes + width x payload itemsize +
+    per-row scale), not a hardcoded f32 row; every group also reports
+    its bucket's at-rest `storage_dtype`, and `DET_DELTA_DTYPE` is the
+    report's default."""
+    from distributed_embeddings_tpu.ops import wire as wire_ops
+
+    specs = [(96, 8, "sum"), (50, 8, "sum"), (100, 16, "sum"),
+             (120, 8, "sum")]
+    dist, _ = make_dist(specs, input_max_hotness=[4, 4, 4, 4])
+    r32 = dist.exchange_padding_report()
+    r8 = dist.exchange_padding_report(delta_dtype="int8")
+    assert r32["delta_dtype"] == "f32" and r8["delta_dtype"] == "int8"
+    for g32, g8 in zip(r32["groups"], r8["groups"]):
+        bucket = dist.plan.tp_buckets[g32["bucket"]]
+        # device-resident buckets: at-rest storage stays f32 by the gate
+        assert g32["storage_dtype"] == "f32"
+        assert g32["delta_bytes_per_step"] == (
+            g32["touched_rows_per_step"]
+            * wire_ops.delta_row_bytes(bucket.width, "f32"))
+        assert g8["delta_bytes_per_step"] == (
+            g8["touched_rows_per_step"]
+            * wire_ops.delta_row_bytes(bucket.width, "int8"))
+        assert g8["delta_bytes_per_step"] < g32["delta_bytes_per_step"]
+    assert r8["delta_bytes_per_step"] == sum(
+        g["delta_bytes_per_step"] for g in r8["groups"])
+    assert set(r32["storage_dtypes"]) == set(
+        range(len(dist.plan.tp_buckets)))
+
+    # the env default drives the report like DET_EXCHANGE_WIRE drives
+    # the wire (explicit argument wins)
+    import os
+    os.environ["DET_DELTA_DTYPE"] = "int8"
+    try:
+        assert dist.exchange_padding_report()["delta_dtype"] == "int8"
+        assert dist.exchange_padding_report(
+            delta_dtype="f32")["delta_dtype"] == "f32"
+    finally:
+        del os.environ["DET_DELTA_DTYPE"]
+
+
 def test_lookahead_prefetch_report_schema():
     """Overlap-window accounting (ISSUE 9): with `lookahead > 0` every
     report group carries `prefetch_patch_rows_per_step` (worst case —

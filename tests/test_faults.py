@@ -504,6 +504,68 @@ def test_config_errors_propagate_not_quarantined(tmp_path):
     assert "different model" in eng.last_poll_error
 
 
+def test_stream_dtype_stamped_and_unsupported_refused(tmp_path):
+    """ISSUE 15 satellite: every written container header carries the
+    payload ``dtype`` (stamped 'f32' when the publisher set none, so
+    legacy-shaped saves stay self-describing), and a dtype the consumer
+    does not support refuses LOUDLY as ValueError — a config error,
+    never `StreamIntegrityError`, never a quarantine (the file is
+    healthy; the fleet is mismatched)."""
+    arrays = {"a": np.arange(8, dtype=np.float32).reshape(2, 4)}
+    path = ckpt_lib.save_row_delta(str(tmp_path / "f.npz"),
+                                   {"kind": "delta", "version": 1}, arrays)
+    assert ckpt_lib.load_row_delta_meta(path)["dtype"] == "f32"
+
+    # the save layer refuses a non-registry dtype at write time
+    with pytest.raises(ValueError, match="not a stream container dtype"):
+        ckpt_lib.save_row_delta(str(tmp_path / "bad.npz"),
+                                {"kind": "delta", "dtype": "int4"}, arrays)
+
+    # a future publisher's dtype (crafted header, valid checksums):
+    # both read layers refuse with the config error, NOT the corrupt one
+    import zlib
+    meta = {"kind": "delta", "version": 2, "dtype": "int4",
+            "container": ckpt_lib.STREAM_CONTAINER_VERSION,
+            "crc": {"a": zlib.crc32(
+                np.ascontiguousarray(arrays["a"]).tobytes()) & 0xFFFFFFFF}}
+    meta["header_crc"] = zlib.crc32(
+        json.dumps(meta, sort_keys=True).encode()) & 0xFFFFFFFF
+    future = str(tmp_path / "future.npz")
+    np.savez(future, __meta__=np.asarray(json.dumps(meta)), **arrays)
+    with pytest.raises(ValueError, match="not supported"):
+        ckpt_lib.load_row_delta(future)
+    with pytest.raises(ValueError, match="not supported"):
+        ckpt_lib.load_row_delta_meta(future)
+    try:
+        ckpt_lib.load_row_delta(future)
+    except ValueError as e:
+        assert not isinstance(e, ckpt_lib.StreamIntegrityError)
+
+    # consumer path: the refusal PROPAGATES (config class), the file is
+    # not quarantined — exactly the sig-mismatch contract
+    dist = make_dist()
+    rng = np.random.RandomState(5)
+    store = TableStore(dist, dist.set_weights(_weights(rng)))
+    pub = str(tmp_path / "pub")
+    os.makedirs(pub)
+    import shutil
+    shutil.copy(future, os.path.join(pub, "stream_v00000001_delta.npz"))
+    cons = DeltaConsumer(store, pub)
+    with pytest.raises(ValueError, match="not supported"):
+        cons.poll()
+    assert cons.quarantined == {}
+
+    # an fp8 stream on a backend without float8 refuses the same way
+    with pytest.MonkeyPatch.context() as mp:
+        from distributed_embeddings_tpu.ops import wire as wire_ops
+        mp.setattr(wire_ops, "fp8_supported", lambda: False)
+        meta8 = {"kind": "delta", "version": 3, "dtype": "fp8"}
+        p8 = ckpt_lib.save_row_delta(str(tmp_path / "f8.npz"), meta8,
+                                     arrays)
+        with pytest.raises(ValueError, match="float8"):
+            ckpt_lib.load_row_delta(p8)
+
+
 # ------------------------------------------------- engine degradation
 def test_engine_poll_never_raises_and_degraded_gauge(tmp_path):
     """`poll_updates` converts every consumer-side fault into degraded

@@ -67,6 +67,16 @@ def gen_config(seed):
         # agreement with the monolithic sparse step (engine-refused
         # configs — offloaded buckets, all-dp plans — skip the axis)
         kw["lookahead_axis"] = True
+    if rng.rand() < 0.3:
+        # storage-dtype axis (ISSUE 15): quantized at-rest rows. The
+        # axis FORCES an offload budget so it always bites (the plan
+        # gate quantizes only offloaded buckets — without a budget the
+        # axis would be inert while still loosening the sweep's exact
+        # tolerances). One decode per offloaded gather + SR write-back
+        # per train step: the bf16-class tolerance covers it.
+        kw["storage_dtype"] = "int8"
+        kw.setdefault("gpu_embedding_size", int(rng.choice([3000, 12000])))
+        kw.update(rtol=4e-2, atol=4e-2, train_rtol=4e-2, train_atol=4e-2)
     return specs, table_map, kw
 
 
@@ -122,6 +132,99 @@ def test_random_config_ragged_and_weighted(seed):
         if "Not enough tables" in str(e):
             pytest.skip(f"seed {seed}: config unplaceable on 8 devices")
         raise
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(6))
+def test_storage_dtype_stream_and_stash_fuzz(seed, tmp_path):
+    """Storage-dtype axis over the train-to-serve row stores (ISSUE 15):
+    random configs through publish -> consume (random delta dtype) and
+    admit -> evict -> re-admit (random stash dtype), asserting the
+    documented per-row decode bounds — and BIT-exactness at f32."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_embeddings_tpu.layers.dist_model_parallel import (
+        DistributedEmbedding)
+    from distributed_embeddings_tpu.layers.embedding import Embedding
+    from distributed_embeddings_tpu.ops import wire as wire_ops
+    from distributed_embeddings_tpu.store import TableStore, scan_published
+    from distributed_embeddings_tpu.vocab import VocabManager
+    from test_dist_model_parallel import make_mesh
+
+    rng = np.random.RandomState(4000 + seed)
+    dtypes = ["f32", "int8"] + (["fp8"] if wire_ops.fp8_supported()
+                                else [])
+    delta_dtype = dtypes[rng.randint(len(dtypes))]
+    stash_dtype = dtypes[rng.randint(len(dtypes))]
+    n = int(rng.randint(6, 10))
+    specs = [(int(rng.choice([40, 120, 500, 1500])),
+              int(rng.choice([8, 16, 32])), "sum") for _ in range(n)]
+    kw = {}
+    if rng.rand() < 0.5:
+        # offload the big tables so the STORED-quantized read/apply
+        # seam (not just the stream codec) is on the fuzzed path
+        kw["gpu_embedding_size"] = 3000
+        kw["storage_dtype"] = delta_dtype
+    mesh = make_mesh(8)
+    W = [rng.randn(v, w).astype(np.float32) * 0.1 for v, w, _ in specs]
+
+    def build():
+        return DistributedEmbedding(
+            [Embedding(v, w, combiner=c) for v, w, c in specs],
+            mesh=mesh, **kw)
+
+    # ---- publish -> consume at the random delta dtype
+    emb = build()
+    store = TableStore(emb, emb.set_weights(W), delta_dtype=delta_dtype)
+    d = str(tmp_path / "pub")
+    store.publish(d)
+    ins = [jnp.asarray(rng.randint(0, v, size=(16, 2)).astype(np.int32))
+           for v, _, _ in specs]
+    store.observe(ins)
+    store.commit(store.params)
+    info = store.publish(d)
+    assert info["dtype"] == delta_dtype
+    assert info["payload_bytes"] == info["model_payload_bytes"]
+    c_emb = build()
+    consumer = TableStore(c_emb, c_emb.init(jax.random.PRNGKey(seed)))
+    for _, _, path in scan_published(d):
+        consumer.apply_published(path)
+    for a, b in zip(store.get_weights(), consumer.get_weights()):
+        if delta_dtype == "f32" and not kw.get("storage_dtype"):
+            np.testing.assert_array_equal(a, b)
+        else:
+            # one encode on publish + (for quantized-at-rest consumers)
+            # one re-encode on apply: two quantization steps bound it
+            bound = 2 * wire_ops.store_decode_bound(a, delta_dtype
+                                                    if delta_dtype != "f32"
+                                                    else kw.get(
+                                                        "storage_dtype",
+                                                        "f32"))
+            assert (np.abs(a - b).max(axis=-1) <= bound + 1e-6).all()
+
+    # ---- admit -> evict -> re-admit with the quantized stash
+    v_emb = DistributedEmbedding(
+        [Embedding(v, w, combiner=c) for v, w, c in specs],
+        mesh=mesh, vocab_slack=16)
+    mgr = VocabManager(v_emb, use_native=False, stash_dtype=stash_dtype)
+    gtid = min(mgr.vocabs)
+    mv = mgr.vocabs[gtid]
+    width = v_emb.strategy.global_configs[gtid]["output_dim"]
+    kcount = int(rng.randint(3, 9))
+    keys = rng.randint(10_000, 20_000, size=kcount).astype(np.int64)
+    keys = np.unique(keys)
+    rows = rng.randn(len(keys), width).astype(np.float32)
+    mv.bind(keys)
+    mv.unbind(keys, rows)
+    for i, k in enumerate(keys):
+        back = mv.stash_take(int(k))
+        assert back is not None
+        if stash_dtype == "f32":
+            np.testing.assert_array_equal(back, rows[i])
+        else:
+            bound = float(wire_ops.store_decode_bound(
+                rows[i], stash_dtype).max())
+            assert np.abs(back - rows[i]).max() <= bound + 1e-6
 
 
 @pytest.mark.slow
