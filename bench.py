@@ -2377,10 +2377,20 @@ SOAK_SCENARIO_DEFAULTS = {
     "replicas": 2, "requests_per_round": 2, "request_batch": 16,
     "poll_every_rounds": 1, "late_join": None,
     "traffic": None, "fault_plan": None,
+    "churn": None, "fleet": None,
 }
 
 _SOAK_VOCAB_DEFAULTS = {"slack": 192, "admit_threshold": 1,
                         "decay": 0.97, "every": 4, "key_space": 4000}
+
+# fleet-tier scenario knobs (ISSUE 16, bench.py --mode fleet); a soak
+# scenario's optional "fleet" dict overrides these
+_FLEET_DEFAULTS = {
+    "cache_capacity": 192, "canaries": 1, "max_queue_depth": 64,
+    "max_queue_rows": None, "vnodes": 32, "fleet_sizes": [1, 2, 4],
+    "keys": 32, "locality": 0.9, "user_window": 32,
+    "sweep_requests": 96,
+}
 
 
 def load_soak_scenario(path_or_doc) -> dict:
@@ -2424,6 +2434,43 @@ def load_soak_scenario(path_or_doc) -> dict:
                 f"soak scenario {sc['name']!r}: late_join.replica must "
                 "be in [1, replicas) — replica 0 serves from the start")
         sc["late_join"] = lj
+    if sc["churn"] is not None:
+        evs = []
+        for ev in sc["churn"]:
+            e = {"at_frac": 0.5, **ev}
+            if e.get("action") not in ("join", "leave"):
+                raise ValueError(
+                    f"soak scenario {sc['name']!r}: churn action must be "
+                    f"'join' or 'leave', got {e.get('action')!r}")
+            if "replica" not in e or int(e["replica"]) < 0:
+                raise ValueError(
+                    f"soak scenario {sc['name']!r}: churn events need a "
+                    "non-negative 'replica' index")
+            if not 0.0 <= float(e["at_frac"]) <= 1.0:
+                raise ValueError(
+                    f"soak scenario {sc['name']!r}: churn at_frac must "
+                    f"be in [0, 1], got {e['at_frac']}")
+            evs.append(e)
+        sc["churn"] = sorted(evs, key=lambda e: float(e["at_frac"]))
+    if sc["fleet"] is not None:
+        fl = {**_FLEET_DEFAULTS, **sc["fleet"]}
+        unknown = set(fl) - set(_FLEET_DEFAULTS)
+        if unknown:
+            raise ValueError(f"soak scenario {sc['name']!r}: unknown "
+                             f"fleet keys {sorted(unknown)}")
+        for k in ("cache_capacity", "canaries", "max_queue_depth",
+                  "vnodes", "keys", "user_window", "sweep_requests"):
+            if int(fl[k]) <= 0:
+                raise ValueError(f"soak scenario {sc['name']!r}: "
+                                 f"fleet.{k} must be positive, got {fl[k]}")
+        if not 0.0 <= float(fl["locality"]) <= 1.0:
+            raise ValueError(f"soak scenario {sc['name']!r}: "
+                             "fleet.locality must be in [0, 1]")
+        if not fl["fleet_sizes"] \
+                or any(int(s) <= 0 for s in fl["fleet_sizes"]):
+            raise ValueError(f"soak scenario {sc['name']!r}: "
+                             "fleet.fleet_sizes must be positive ints")
+        sc["fleet"] = fl
     if sc["fault_plan"] is not None:
         from distributed_embeddings_tpu import faults
         faults.FaultPlan.from_json(sc["fault_plan"])   # spec validation
@@ -2657,10 +2704,27 @@ def _run_soak_bench_inner(scenario: dict, pub_dir: str) -> dict:
 
     state = {"rounds": 0}
     poll_every = max(int(sc["poll_every_rounds"]), 1)
+    churn_events = [dict(ev) for ev in (sc["churn"] or [])]
 
     class _FleetCallback:
         def on_step(self, step, p, loss):
             frac = (step + 1) / max(steps, 1)
+            # scripted membership churn (ISSUE 16): a leave tears the
+            # replica down mid-stream, a join (re)creates one that
+            # re-anchors from the newest snapshot — same path late_join
+            # takes; the recovery loop below revives left members so the
+            # final parity audit still covers every index
+            for ev in churn_events:
+                if not ev.get("_done") and frac >= float(ev["at_frac"]):
+                    ev["_done"] = True
+                    i = int(ev["replica"])
+                    if i >= len(replicas):
+                        replicas.extend(
+                            [None] * (i + 1 - len(replicas)))
+                    if ev["action"] == "leave":
+                        replicas[i] = None
+                    elif replicas[i] is None:
+                        replicas[i] = make_replica(i)
             if lj is not None and replicas[int(lj["replica"])] is None \
                     and frac >= float(lj["at_frac"]):
                 # late join: a fresh replica re-anchors from the newest
@@ -2921,6 +2985,441 @@ def soak_main(argv=None) -> int:
           and record.get("soak_quarantine_unreconciled", 1) == 0
           and record.get("soak_postmortem_unreconciled", 1) == 0
           and record.get("soak_parity_max_dev", 1.0) == 0.0)
+    slo = record.get("slo_findings")
+    if isinstance(slo, dict) and slo.get("count"):
+        ok = False
+    return 0 if ok else 1
+
+
+# ------------------------------------------------------------- fleet mode
+# (ISSUE 16) The serving fleet tier: a FleetRouter consistent-hashes
+# keyed request batches over an elastic replica fleet (each replica an
+# InferenceEngine + MicroBatcher with a replica= label on the shared
+# registry), sheds on queue pressure with typed results, joins/leaves
+# members mid-traffic, and promotes published versions fleet-wide only
+# after the canaries report bit-exact parity against the publisher.
+# Scenarios are the soak's JSON format plus the optional "churn" /
+# "fleet" keys; tools/soak_scenarios/replica_churn.json is the
+# reference adversarial run.
+
+
+def run_fleet_bench(scenario: dict) -> dict:
+    """One fleet-tier run. Returns the record; the acceptance gates ride
+    as ``fleet/*`` gauges on the default registry so tools/slo_soak.json
+    can address them:
+
+      * ``fleet/parity_max_dev`` — max |publisher - serving replica|
+        after the recovery version promotes (0.0 = bit-exact fleet);
+      * ``fleet/idle_sheds`` — sheds during the single-request idle arm
+        (must be 0: admission control never sheds an unloaded fleet);
+      * ``fleet/replicas_unrouted`` — serving replicas owning zero
+        request keys (0 = routing covers the whole rotation);
+      * ``fleet/bad_version_served`` — non-canary members ever observed
+        at a condemned version (0 = rollback containment held).
+    """
+    import shutil
+    import tempfile
+
+    from distributed_embeddings_tpu import faults
+
+    pub_dir = tempfile.mkdtemp(prefix="det_fleet_")
+    try:
+        return _run_fleet_bench_inner(scenario, pub_dir)
+    finally:
+        faults.set_plan(None)
+        shutil.rmtree(pub_dir, ignore_errors=True)
+
+
+def _run_fleet_bench_inner(scenario: dict, pub_dir: str) -> dict:
+    from distributed_embeddings_tpu import faults, obs, training
+    from distributed_embeddings_tpu.fleet import (AdmissionController,
+                                                  FleetRouter, HashRing)
+    from distributed_embeddings_tpu.parallel.mesh import create_mesh
+    from distributed_embeddings_tpu.serving import InferenceEngine
+    from distributed_embeddings_tpu.store import TableStore
+
+    sc = scenario
+    fl = sc["fleet"] or dict(_FLEET_DEFAULTS)
+    record = {"metric": "fleet_tier", "git_sha": _git_sha()}
+    if sc["vocab_manage"] is not None:
+        record["fleet_error"] = ("fleet mode serves physical ids; "
+                                 "vocab_manage scenarios belong to "
+                                 "--mode soak")
+        return record
+    if int(sc["lookahead"]):
+        record["fleet_error"] = (
+            "fleet mode host-offloads every bucket so the HotRowCache "
+            "tier is in the serve path, and lookahead>0 cannot patch "
+            "offloaded lookups (the refusal training.fit raises); set "
+            "lookahead: 0 in the scenario")
+        return record
+    _ha = _load_hlo_audit()
+    devs = jax.devices()
+    world = min(int(sc["world"]), len(devs))
+    if world < 2:
+        record["fleet_error"] = ("fleet bench needs a multi-device "
+                                 f"mesh, have {len(devs)} device(s)")
+        return record
+    mesh = create_mesh(devs[:world])
+    reg = obs.default_registry()
+    obs.reset_default_recorder()
+    seed = int(sc["seed"])
+    tables, vocab_rows = int(sc["tables"]), int(sc["vocab"])
+    width, hotness = int(sc["width"]), int(sc["hotness"])
+    steps, batch = int(sc["steps"]), int(sc["batch"])
+    rb = int(sc["request_batch"])
+    n_keys, win = int(fl["keys"]), int(fl["user_window"])
+    locality = float(fl["locality"])
+
+    # a one-element device budget host-offloads every bucket: the
+    # serving-tier memory shape (tables in host memory, HotRowCache in
+    # HBM on top) — hit rate as a function of fleet size is the whole
+    # point of key-affine routing, so the cache must be in the path
+    gpu_budget = 1
+
+    def build():
+        return _ha._build_model(vocab_rows, width, "sum", tables=tables,
+                                mesh=mesh, gpu_embedding_size=gpu_budget)
+
+    model = build()
+    emb = model.embedding
+    params = {"embedding": emb.init(jax.random.PRNGKey(seed))}
+    pub_store = TableStore(emb, params["embedding"],
+                           snapshot_every=int(sc["snapshot_every"]))
+    plan = (faults.FaultPlan.from_json(sc["fault_plan"])
+            if sc["fault_plan"] else None)
+    faults.set_plan(plan)
+
+    traffic = _SoakTraffic(sc, vocab_rows, 0, np.random.RandomState(seed))
+
+    def train_batches():
+        for s in range(steps):
+            yield traffic.batch(batch, hotness, tables,
+                                (s + 1) / steps, np.int32)
+
+    zipf_p = np.arange(1, vocab_rows + 1, dtype=np.float64) \
+        ** -float(sc["alpha"])
+    zipf_p /= zipf_p.sum()
+
+    def keyed_request(key, rng):
+        """Key-affine request content: `locality` of the ids come from
+        the key's own vocab window (a user's recurring items), the rest
+        from the global zipf tail — a replica that keeps seeing the
+        same keys warms its cache for exactly those windows."""
+        n = rb * hotness
+        base = (int(key) * 2654435761) % max(vocab_rows - win, 1)
+        n_local = int(round(n * locality))
+        cats = []
+        for _ in range(tables):
+            ids = np.empty(n, np.int64)
+            ids[:n_local] = base + rng.randint(0, win, size=n_local)
+            ids[n_local:] = rng.choice(vocab_rows, size=n - n_local,
+                                       p=zipf_p)
+            rng.shuffle(ids)
+            cats.append(ids.reshape(rb, hotness).astype(np.int32))
+        return cats
+
+    def reference_weights(version):
+        # parity gates only when the publisher's in-memory tables ARE
+        # that version; a paused publish leaves the newest on-disk
+        # version behind the store's, and the verdict is health-only
+        # rather than condemning a healthy file against future bytes
+        if int(version) != int(pub_store.version):
+            return None
+        return pub_store.get_weights()
+
+    def make_replica(i: int) -> InferenceEngine:
+        remb = build().embedding
+        return InferenceEngine(
+            remb, remb.init(jax.random.PRNGKey(seed + 100 + i)),
+            cache_capacity=int(fl["cache_capacity"]), registry=reg,
+            replica=f"r{i}")
+
+    router = FleetRouter(
+        pub_dir, registry=reg, vnodes=int(fl["vnodes"]),
+        canaries=int(fl["canaries"]),
+        reference_weights=reference_weights,
+        admission=AdmissionController(
+            int(fl["max_queue_depth"]),
+            None if fl["max_queue_rows"] is None
+            else int(fl["max_queue_rows"])))
+    for i in range(int(sc["replicas"])):
+        router.add_replica(f"r{i}", make_replica(i))
+
+    churn_events = [dict(ev) for ev in (sc["churn"] or [])]
+    churn_log = []
+    state = {"rounds": 0, "serve_s": 0.0}
+    poll_every = max(int(sc["poll_every_rounds"]), 1)
+    rpr = int(sc["requests_per_round"])
+    key_rng = np.random.RandomState(seed + 555)
+
+    class _FleetTierCallback:
+        # single-threaded serve-from-fit-callback, the soak's thread
+        # model: XLA:CPU collectives deadlock across threads, and one
+        # dispatch order keeps the fault plan's occurrences replayable
+        def on_step(self, step, p, loss):
+            frac = (step + 1) / max(steps, 1)
+            for ev in churn_events:
+                if not ev.get("_done") and frac >= float(ev["at_frac"]):
+                    ev["_done"] = True
+                    i = int(ev["replica"])
+                    name = f"r{i}"
+                    entry = {"step": int(step), "action": ev["action"],
+                             "replica": name}
+                    try:
+                        if ev["action"] == "leave":
+                            router.remove_replica(name)
+                        elif name not in router._members:
+                            router.add_replica(name, make_replica(i))
+                    except Exception as e:  # noqa: BLE001 - churn must not kill fit
+                        entry["error"] = \
+                            f"{type(e).__name__}: {e}"[:200]
+                    churn_log.append(entry)
+            t0 = time.perf_counter()
+            n_req = rpr * max(len(router._serving()), 1)
+            for _ in range(n_req):
+                key = int(key_rng.randint(0, n_keys))
+                router.submit(keyed_request(key, key_rng), key=key)
+            router.flush()
+            state["serve_s"] += time.perf_counter() - t0
+            if state["rounds"] % poll_every == 0:
+                router.step()
+            state["rounds"] += 1
+
+    fit_result = {}
+    try:
+        p, o, h = training.fit(
+            model, params, train_batches(), steps=steps,
+            optimizer=sc["optimizer"], lr=float(sc["lr"]),
+            log_every=0, callbacks=[_FleetTierCallback()],
+            store=pub_store, publish_every=int(sc["publish_every"]),
+            publish_dir=pub_dir, lookahead=int(sc["lookahead"]),
+            registry=reg)
+        fit_result["params"], fit_result["opt"] = p, o
+        fit_result["history"] = h
+    except Exception as e:  # noqa: BLE001 - surfaced in the record
+        import traceback
+        traceback.print_exc()
+        fit_result["error"] = f"{type(e).__name__}: {e}"[:300]
+    finally:
+        faults.set_plan(None)
+
+    record.update({
+        "backend": devs[0].platform,
+        "fleet_scenario": sc["name"],
+        "fleet_steps": steps, "fleet_world": world,
+        "fleet_replicas_start": int(sc["replicas"]),
+        "fleet_rounds": state["rounds"],
+    })
+    if "error" in fit_result:
+        record["fleet_error"] = fit_result["error"]
+        return record
+
+    # ---- recovery: one clean snapshot, promoted through the canaries --
+    pub_store.commit(fit_result["params"]["embedding"],
+                     fit_result["opt"]["emb"])
+    recovery = pub_store.publish(pub_dir, force_snapshot=True)
+    promote_ticks = 0
+    while router.pinned_version < recovery["version"] \
+            and promote_ticks < 8:
+        router.step()
+        promote_ticks += 1
+    promoted = router.pinned_version == recovery["version"]
+
+    # ---- parity: the serving fleet is bit-exact at the promoted pin ---
+    want = [np.asarray(w) for w in pub_store.get_weights()]
+    serving = router._serving()
+    parity = 0.0
+    for m in serving:
+        for a, b in zip(want, m.engine.store.get_weights()):
+            if a.size:
+                parity = max(parity, float(np.max(np.abs(
+                    a - np.asarray(b)))))
+
+    # ---- idle arm: an unloaded fleet never sheds -----------------------
+    shed_before = router.shed
+    for k in range(max(len(serving), 1)):
+        router.submit(keyed_request(k, key_rng), key=k)
+        router.flush()
+    idle_sheds = router.shed - shed_before
+
+    # ---- burst arm: same-key overload sheds typed, never raises --------
+    shed_before = router.shed
+    burst_n = 3 * int(fl["max_queue_depth"])
+    burst_reasons: dict = {}
+    for _ in range(burst_n):
+        r = router.submit(keyed_request(7, key_rng), key=7)
+        if not r:
+            burst_reasons[r.shed_reason] = \
+                burst_reasons.get(r.shed_reason, 0) + 1
+    router.flush()
+    burst_sheds = router.shed - shed_before
+
+    # ---- routing coverage over the key space ---------------------------
+    assign = router.ring.assignments(range(n_keys))
+    keys_per_replica = {m.name: 0 for m in serving}
+    for owner in assign.values():
+        if owner in keys_per_replica:
+            keys_per_replica[owner] += 1
+    replicas_unrouted = sum(1 for v in keys_per_replica.values()
+                            if v == 0)
+
+    # ---- hit rate vs fleet size: fresh sub-fleets replay ONE keyed
+    # stream (same seed per size) so the only variable is how many
+    # replicas split the key space over the same per-replica cache
+    def hit_rate_at(size: int) -> dict:
+        ring = HashRing(int(fl["vnodes"]))
+        engs = {}
+        for i in range(size):
+            e = make_replica(900 + i)
+            e.poll_updates(pub_dir)        # re-anchor on the recovery
+            name = f"s{i}"
+            ring.add(name)
+            engs[name] = e
+        srng = np.random.RandomState(seed + 4242)
+        for _ in range(int(fl["sweep_requests"])):
+            key = int(srng.randint(0, n_keys))
+            out = engs[ring.route(key)].predict(keyed_request(key, srng))
+            for o in out:
+                np.asarray(o)
+        caches = [c for e in engs.values()
+                  for c in (getattr(e, "caches", {}) or {}).values()]
+        hits = sum(c.hits for c in caches)
+        misses = sum(c.misses for c in caches)
+        return {"fleet_size": size,
+                "hit_rate": round(hits / (hits + misses), 4)
+                if hits + misses else 0.0}
+
+    hit_curve = [hit_rate_at(int(s)) for s in fl["fleet_sizes"]]
+
+    # ---- latency: per-replica histograms + the fleet-wide merge (the
+    # UNLABELED serve/request_seconds family = the whole fleet, so the
+    # shared "requests-served" SLO rule addresses fleet runs too)
+    replica_names = sorted(
+        {f"r{i}" for i in range(int(sc['replicas']))}
+        | {f"r{int(ev['replica'])}" for ev in (sc["churn"] or [])})
+    fleet_hist = reg.histogram("serve/request_seconds")
+    per_replica = {}
+    for name in replica_names:
+        h = reg.histogram("serve/request_seconds", replica=name)
+        if h.count:
+            s = h.summary()
+            per_replica[name] = {k: s[k]
+                                 for k in ("count", "p50_ms", "p99_ms")}
+            fleet_hist.merge(h)
+    fleet_summ = fleet_hist.summary()
+
+    stats = router.stats()
+    admitted = router.submitted - router.shed
+    bad_served = reg.counter("fleet/bad_version_served_total").value
+    record.update({
+        "fleet_routed_qps": round(admitted / state["serve_s"], 2)
+        if state["serve_s"] else 0.0,
+        "fleet_submitted": router.submitted,
+        "fleet_shed": router.shed,
+        "fleet_shed_rate": stats["shed_rate"],
+        "fleet_shed_by_reason": {
+            r: reg.counter("fleet/shed_total", reason=r).value
+            for r in ("queue_depth", "queue_rows", "no_replicas",
+                      "oversize", "router_error")
+            if reg.counter("fleet/shed_total", reason=r).value},
+        "fleet_serve_requests": fleet_summ["count"],
+        "fleet_serve_p50_ms": fleet_summ["p50_ms"],
+        "fleet_serve_p99_ms": fleet_summ["p99_ms"],
+        "fleet_replica_latency": per_replica,
+        "fleet_hit_rate_curve": hit_curve,
+        "fleet_canary_events": router.rollout.events[:50],
+        "fleet_promotes": stats["promotes"],
+        "fleet_rollbacks": stats["rollbacks"],
+        "fleet_bad_versions": stats["bad_versions"],
+        "fleet_pinned_version": stats["pinned_version"],
+        "fleet_recovery_version": recovery["version"],
+        "fleet_recovery_promoted": promoted,
+        "fleet_parity_max_dev": parity,
+        "fleet_idle_sheds": idle_sheds,
+        "fleet_burst_submitted": burst_n,
+        "fleet_burst_sheds": burst_sheds,
+        "fleet_burst_shed_reasons": burst_reasons,
+        "fleet_replicas_unrouted": replicas_unrouted,
+        "fleet_keys_per_replica": keys_per_replica,
+        "fleet_churn_events": churn_log,
+        "fleet_bad_version_served": bad_served,
+        "fleet_router_errors": stats["router_errors"],
+        "fleet_router_error_examples": router.errors[:5],
+        "fleet_member_stats": stats["members"],
+    })
+
+    # the SLO-addressable acceptance gauges (tools/slo_soak.json)
+    reg.gauge("fleet/parity_max_dev").set(parity)
+    reg.gauge("fleet/idle_sheds").set(idle_sheds)
+    reg.gauge("fleet/replicas_unrouted").set(replicas_unrouted)
+    reg.gauge("fleet/bad_version_served").set(bad_served)
+    reg.gauge("fleet/recovery_promoted").set(1 if promoted else 0)
+    return record
+
+
+def fleet_main(argv=None) -> int:
+    """`bench.py --mode fleet` entry point: one JSON line, like main()."""
+    import argparse
+    p = argparse.ArgumentParser(
+        description="serving fleet tier bench (ISSUE 16)")
+    p.add_argument("--mode", choices=["fleet"], default="fleet")
+    p.add_argument("--scenario", required=True,
+                   help="scenario JSON file (tools/soak_scenarios/)")
+    p.add_argument("--steps", type=int, default=None,
+                   help="override the scenario's step count")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="override the scenario's starting fleet size")
+    _add_profile_arg(p)
+    args = p.parse_args(argv)
+    if os.environ.get("DET_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        scenario = load_soak_scenario(args.scenario)
+        if args.steps is not None:
+            scenario["steps"] = args.steps
+        if args.replicas is not None:
+            scenario["replicas"] = args.replicas
+        if args.steps is not None or args.replicas is not None:
+            scenario = load_soak_scenario(scenario)
+        _load_hlo_audit()._ensure_world(max(2, int(scenario["world"])))
+        record = _run_with_device_attribution(
+            lambda: run_fleet_bench(scenario), args.profile)
+    except Exception as e:  # noqa: BLE001 - one JSON line, like main()
+        import traceback
+        traceback.print_exc()
+        record = {"metric": "fleet_tier",
+                  "fleet_error": str(e)[:300], "git_sha": _git_sha()}
+    trace_path = os.environ.get("DET_OBS_TRACE")
+    if trace_path:
+        try:
+            from distributed_embeddings_tpu.obs import default_recorder
+            doc = default_recorder().export(trace_path)
+            record["trace_export"] = {
+                "path": trace_path,
+                "events": len(doc["traceEvents"]),
+                "dropped": doc["metadata"]["dropped_events"]}
+        except Exception as e:  # noqa: BLE001 - artifact, not the record
+            record["trace_export"] = {"error": str(e)[:200]}
+    record = _stamp_audit_findings(record)
+    try:
+        # the audit result doubles as the `audit/findings` gauge so the
+        # SLO rule file gates it alongside the fleet gauges (the
+        # obs_smoke idiom)
+        from distributed_embeddings_tpu.obs import default_registry
+        af = record.get("audit_findings", {})
+        default_registry().gauge("audit/findings").set(
+            af["count"] if isinstance(af, dict) and "count" in af else -1)
+    except Exception:  # noqa: BLE001 - accounting must not kill the bench
+        pass
+    record = _stamp_metrics_snapshot(record)
+    print(json.dumps(record))
+    ok = ("fleet_error" not in record
+          and record.get("fleet_idle_sheds", 1) == 0
+          and record.get("fleet_replicas_unrouted", 1) == 0
+          and record.get("fleet_bad_version_served", 1) == 0
+          and record.get("fleet_recovery_promoted") is True
+          and record.get("fleet_parity_max_dev", 1.0) == 0.0)
     slo = record.get("slo_findings")
     if isinstance(slo, dict) and slo.get("count"):
         ok = False
@@ -3420,6 +3919,8 @@ if __name__ == "__main__":
         sys.exit(kernels_main(sys.argv[1:]))
     elif _cli_mode() == "soak":
         sys.exit(soak_main(sys.argv[1:]))
+    elif _cli_mode() == "fleet":
+        sys.exit(fleet_main(sys.argv[1:]))
     elif _cli_mode() == "storedtype":
         sys.exit(storedtype_main(sys.argv[1:]))
     elif os.environ.get("DET_BENCH_INNER") == "1":

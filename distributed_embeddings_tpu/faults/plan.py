@@ -27,6 +27,11 @@ Injection points (the seam calls `faults.check(point, ...)` /
   * ``consumer.poll``     — `DeltaConsumer.poll` entry; ``io_error``.
   * ``ingest.stage``      — ingest-pipeline stage bodies; ``io_error``
     (the stage worker retries transient errors in place).
+  * ``fleet.canary_apply`` — the fleet rollout's canary-evaluation seam
+    (ISSUE 16). Kind ``bit_flip``: the canary replica's freshly-applied
+    table state is perturbed IN MEMORY (one element) before the parity
+    check — the apply-went-wrong class the canaried rollout must catch
+    and roll back; the stream files on disk stay healthy.
 
 A plan is data:  ``{"seed": 7, "faults": [{"point": "store.publish",
 "kind": "bit_flip", "at": [1]}, ...]}`` — installed via the
@@ -66,6 +71,7 @@ POINTS: Dict[str, Tuple[str, ...]] = {
     "store.load": ("io_error",),
     "consumer.poll": ("io_error",),
     "ingest.stage": ("io_error",),
+    "fleet.canary_apply": ("bit_flip",),
 }
 
 # kinds that leave a CORRUPT published file behind (the quarantine set a

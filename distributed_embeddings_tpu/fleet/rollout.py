@@ -1,0 +1,213 @@
+"""Canaried version rollout for the serving fleet (ISSUE 16).
+
+A freshly published version serves fleet-wide only after K canary
+replicas vouch for it:
+
+  1. **candidate** — the newest published version above the pinned one
+     (skipping versions already marked bad);
+  2. **canary** — the first K serving replicas poll up to the candidate
+     (`upto=` version ceiling) while the rest of the fleet stays pinned;
+  3. **verdict** — a canary passes when it reached the candidate with no
+     active degradation AND (when a reference is wired) its
+     `TableStore.get_weights()` matches the publisher's bit-exactly
+     (``parity_atol=0.0`` f32 by default);
+  4. **promote** — all canaries pass: the pin advances, every other
+     serving replica polls up to it, and a ``fleet/canary_promote``
+     instant lands on the flight recorder next to the version's lineage
+     track;
+  5. **rollback** — any canary fails: the version is marked bad (never
+     retried, never served fleet-wide), the canaries re-anchor on the
+     pinned version via `InferenceEngine.reanchor_published`, and a
+     ``fleet/canary_rollback`` instant records the incident.
+
+A canary that merely CANNOT REACH the candidate yet (delta chain waiting
+on the publisher's next compaction — e.g. after a paused publish) is
+*pending*, not bad: the rollout retries on the next tick. Only a canary
+that landed degraded or off-parity condemns a version.
+
+The ``fleet.canary_apply`` fault point fires here: a ``bit_flip`` spec
+perturbs one element of the canary's freshly-applied tables in memory —
+the apply-went-wrong failure class the parity check must catch. The
+stream files on disk stay healthy, so the SAME bytes that failed the
+canary may later serve fine when a newer version promotes through them.
+"""
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from distributed_embeddings_tpu import faults
+from distributed_embeddings_tpu.obs import trace as obs_trace
+from distributed_embeddings_tpu.store import scan_published
+
+__all__ = ["CanaryController"]
+
+
+class CanaryController:
+    """Owns the pin, the bad-version set, and the promote/rollback
+    ledger. Driven by `FleetRouter.step()`; usable standalone in tests.
+
+    Args:
+      publish_dir: the publisher's stream directory.
+      canaries: how many serving replicas vouch per version (capped at
+        the serving count; default ``DET_FLEET_CANARIES`` env, else 1).
+      reference_weights: optional ``f(version) -> list[np.ndarray] |
+        None`` returning the publisher's `get_weights()` at `version`
+        (None = skip parity for that version). Without it the verdict is
+        health-only.
+      parity_atol: max |canary - reference| tolerated (default 0.0 —
+        bit-exact f32, the acceptance bar).
+      registry: optional `obs.MetricRegistry` for the rollout counters
+        (``fleet/promotes_total``, ``fleet/rollbacks_total``) and gauges
+        (``fleet/pinned_version``, ``fleet/bad_versions``).
+    """
+
+    def __init__(self, publish_dir: str, *, canaries: Optional[int] = None,
+                 reference_weights: Optional[Callable] = None,
+                 parity_atol: float = 0.0, registry=None):
+        import os
+        if canaries is None:
+            canaries = int(os.environ.get("DET_FLEET_CANARIES", 1))
+        self.publish_dir = publish_dir
+        self.canaries = max(int(canaries), 1)
+        self.reference_weights = reference_weights
+        self.parity_atol = float(parity_atol)
+        from distributed_embeddings_tpu.obs.registry import MetricRegistry
+        self._metrics = registry if registry is not None \
+            else MetricRegistry()
+        self.pinned_version = 0
+        self.bad_versions: set = set()
+        self.events: List[dict] = []
+        self._metrics.gauge("fleet/pinned_version").set(0)
+
+    # ------------------------------------------------------------ internals
+    def candidate(self) -> Optional[int]:
+        """Newest published version above the pin that is not
+        condemned (None = nothing to roll out)."""
+        cand = [v for v, _, _ in scan_published(self.publish_dir)
+                if v > self.pinned_version and v not in self.bad_versions]
+        return max(cand) if cand else None
+
+    def _parity_dev(self, engine, version: int) -> Optional[float]:
+        if self.reference_weights is None:
+            return None
+        ref = self.reference_weights(version)
+        if ref is None:
+            return None
+        dev = 0.0
+        for a, b in zip(ref, engine.store.get_weights()):
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            if a.size:
+                dev = max(dev, float(np.max(np.abs(a - b))))
+        return dev
+
+    def _rollback_one(self, member, backup) -> None:
+        """Return one canary to the pinned state. Stream re-anchor when
+        a pinned snapshot exists; in-memory backup otherwise (pin 0 =
+        nothing ever promoted, so there is nothing published to anchor
+        on)."""
+        eng = member.engine
+        try:
+            if self.pinned_version > 0:
+                eng.reanchor_published(self.publish_dir,
+                                       upto=self.pinned_version)
+                return
+        except Exception:  # noqa: BLE001 - fall through to the backup
+            pass
+        params = eng.embedding.set_weights(backup)
+        if eng._model is not None:
+            params = {**eng.params, "embedding": params}
+        eng.set_params(params, refresh=True)
+
+    # ---------------------------------------------------------------- API
+    def advance(self, serving: Sequence) -> Optional[dict]:
+        """One rollout tick over the serving members (objects exposing
+        ``.name`` and ``.engine``, rotation order). Returns None when
+        idle, else a dict with ``event`` in {"pending", "promote",
+        "rollback"}. Promote/rollback land in `events` and on the
+        flight recorder; pending is transient and only returned."""
+        members = list(serving)
+        if not members:
+            return None
+        target = self.candidate()
+        if target is None:
+            return None
+        canaries = members[:min(self.canaries, len(members))]
+        rest = members[len(canaries):]
+        rec = obs_trace.default_recorder()
+
+        results, reached_all = [], True
+        for m in canaries:
+            backup = [np.asarray(w, np.float32).copy()
+                      for w in m.engine.store.get_weights()]
+            m.engine.poll_updates(self.publish_dir, upto=target)
+            reached = int(m.engine.store.version) >= target
+            dev = None
+            if reached:
+                # the canary-apply fault seam: deterministic in-memory
+                # perturbation of the freshly-applied tables (see
+                # module docstring) — occurrence counted per canary
+                # evaluation that actually reached the candidate
+                spec = faults.check("fleet.canary_apply", replica=m.name,
+                                    version=target)
+                if spec is not None and spec.kind == "bit_flip":
+                    w = [np.asarray(t, np.float32).copy()
+                         for t in m.engine.store.get_weights()]
+                    w[0].flat[0] += 1.0
+                    params = m.engine.embedding.set_weights(w)
+                    if m.engine._model is not None:
+                        params = {**m.engine.params, "embedding": params}
+                    m.engine.set_params(params, refresh=True)
+                dev = self._parity_dev(m.engine, target)
+            degraded = sorted(m.engine.degraded_reasons())
+            ok = (reached and not degraded
+                  and (dev is None or dev <= self.parity_atol))
+            reached_all = reached_all and reached
+            results.append({"replica": m.name, "reached": reached,
+                            "degraded": degraded, "parity_dev": dev,
+                            "ok": ok, "backup": backup})
+
+        if all(r["ok"] for r in results):
+            self.pinned_version = target
+            for m in rest:
+                m.engine.poll_updates(self.publish_dir, upto=target)
+            event = {"event": "promote", "version": target,
+                     "canaries": [r["replica"] for r in results],
+                     "parity_devs": [r["parity_dev"] for r in results]}
+            rec.instant("fleet/canary_promote", version=target,
+                        canaries=",".join(r["replica"] for r in results))
+            self._metrics.counter("fleet/promotes_total").inc()
+            self._metrics.gauge("fleet/pinned_version").set(target)
+        elif reached_all or any(not r["ok"] and r["reached"]
+                                for r in results):
+            # at least one canary REACHED the candidate and failed it:
+            # condemn the version and pull every canary back to the pin
+            self.bad_versions.add(target)
+            for m, r in zip(canaries, results):
+                self._rollback_one(m, r["backup"])
+            event = {"event": "rollback", "version": target,
+                     "pinned": self.pinned_version,
+                     "canaries": [r["replica"] for r in results],
+                     "failed": [r["replica"] for r in results
+                                if not r["ok"]],
+                     "parity_devs": [r["parity_dev"] for r in results],
+                     "degraded": sorted({d for r in results
+                                         for d in r["degraded"]})}
+            rec.instant("fleet/canary_rollback", version=target,
+                        pinned=self.pinned_version,
+                        failed=",".join(event["failed"]))
+            self._metrics.counter("fleet/rollbacks_total").inc()
+            self._metrics.gauge("fleet/bad_versions").set(
+                len(self.bad_versions))
+        else:
+            # no canary reached the candidate (chain waiting on the next
+            # compaction): retry next tick, condemn nothing
+            return {"event": "pending", "version": target,
+                    "reached": [r["replica"] for r in results
+                                if r["reached"]]}
+        for r in results:
+            r.pop("backup", None)
+        event["results"] = results
+        self.events.append(event)
+        return event

@@ -48,11 +48,18 @@ class MicroBatcher:
         ``serve/batches``, ``serve/batch_occupancy``,
         ``serve/cache_hit_rate``). Default: a private registry —
         per-batcher accounting, the historical behavior.
+      replica: optional replica name (fleet tier, ISSUE 16) — every
+        metric family above then carries a ``replica=`` label so one
+        shared registry hosts a whole fleet's batchers without
+        collisions, and per-replica p50/p99 stay addressable. Default:
+        the engine's own ``replica`` name, so an engine built with one
+        labels its batcher consistently for free.
     """
 
     def __init__(self, engine, max_batch: Optional[int] = None,
                  clock: Callable[[], float] = time.perf_counter,
-                 registry: Optional[MetricRegistry] = None):
+                 registry: Optional[MetricRegistry] = None,
+                 replica: Optional[str] = None):
         self.engine = engine
         warmed = getattr(engine, "_warmed", [])
         self.max_batch = int(max_batch or (max(warmed) if warmed else 1024))
@@ -61,7 +68,12 @@ class MicroBatcher:
         self._next_handle = 0
         self._metrics = registry if registry is not None \
             else MetricRegistry()
-        self.latency = self._metrics.histogram("serve/request_seconds")
+        if replica is None:
+            replica = getattr(engine, "replica", None)
+        self.replica = replica
+        self._labels = {} if replica is None else {"replica": str(replica)}
+        self.latency = self._metrics.histogram("serve/request_seconds",
+                                               **self._labels)
         self.requests = 0
         self.batches = 0
         self.queue_depth_max = 0
@@ -86,13 +98,19 @@ class MicroBatcher:
         self._next_handle += 1
         self._queue.append((handle, numerical, cats, rows, self.clock()))
         self.requests += 1
-        self._metrics.counter("serve/requests").inc()
+        self._metrics.counter("serve/requests", **self._labels).inc()
         self.queue_depth_max = max(self.queue_depth_max, len(self._queue))
         return handle
 
     @property
     def queue_depth(self) -> int:
         return len(self._queue)
+
+    @property
+    def queued_rows(self) -> int:
+        """True rows currently queued (the row-level occupancy signal
+        fleet admission control sheds on, next to `queue_depth`)."""
+        return sum(req[3] for req in self._queue)
 
     def _concat(self, parts: List):
         if isinstance(parts[0], tuple):
@@ -126,7 +144,7 @@ class MicroBatcher:
             done = self.clock()
             padded = self.engine._target_batch(rows)
             self.batches += 1
-            self._metrics.counter("serve/batches").inc()
+            self._metrics.counter("serve/batches", **self._labels).inc()
             self._occupancy_rows += rows
             self._padded_rows += padded
             start = 0
@@ -136,7 +154,7 @@ class MicroBatcher:
                 start += n
                 self.latency.record(done - t_in)
         m = self._metrics
-        m.gauge("serve/batch_occupancy").set(
+        m.gauge("serve/batch_occupancy", **self._labels).set(
             self._occupancy_rows / self._padded_rows
             if self._padded_rows else 0.0)
         # cheap attribute sums, not cache_stats() (which builds
@@ -144,7 +162,7 @@ class MicroBatcher:
         caches = getattr(self.engine, "caches", {}) or {}
         hits = sum(c.hits for c in caches.values())
         misses = sum(c.misses for c in caches.values())
-        m.gauge("serve/cache_hit_rate").set(
+        m.gauge("serve/cache_hit_rate", **self._labels).set(
             hits / (hits + misses) if hits + misses else 0.0)
         return results
 

@@ -99,11 +99,18 @@ class InferenceEngine:
         (``store/applies``, ``store/version_lag``,
         ``store/publish_to_apply_seconds``...). Default: a private
         registry per engine.
+      replica: optional replica name (fleet tier, ISSUE 16). When set,
+        every ``serve/*`` metric family this engine reports carries a
+        ``replica=`` label, so ONE shared `MetricRegistry` can host a
+        whole fleet without key collisions (``store/*`` families stay
+        unlabeled — counters aggregate across the fleet, which is the
+        fleet-wide reading the soak gates want).
     """
 
     def __init__(self, model, params, *, cache_capacity=0,
                  promote_threshold: int = 2, donate_batch: bool = False,
-                 vocab_manager=None, registry=None):
+                 vocab_manager=None, registry=None,
+                 replica: Optional[str] = None):
         if isinstance(model, DistributedEmbedding):
             self._model = None
             self.embedding = model
@@ -121,6 +128,8 @@ class InferenceEngine:
         from distributed_embeddings_tpu.obs.registry import MetricRegistry
         self._metrics = registry if registry is not None \
             else MetricRegistry()
+        self.replica = replica
+        self._labels = {} if replica is None else {"replica": str(replica)}
         # versioned ownership (ISSUE 6): the embedding tables live behind
         # a TableStore — `refresh()` and delta consumption read/write
         # through it, so serving can never hold a second derivation of
@@ -150,6 +159,7 @@ class InferenceEngine:
 
         emb = self.embedding
         self.caches: Dict[int, HotRowCache] = {}
+        bypassed: List[int] = []
         if emb._offload_enabled:
             off = [b for b, bk in enumerate(emb.plan.tp_buckets)
                    if bk.offload]
@@ -163,16 +173,27 @@ class InferenceEngine:
                     # decode seam yet — serve through the stock
                     # decode-at-gather lookup instead of refusing the
                     # whole engine
-                    import warnings
-                    warnings.warn(
-                        f"serving cache skipped for bucket {b}: it "
-                        f"stores {emb._bucket_store_dtype(b)} rows; "
-                        "requests fall back to the decoded host lookup",
-                        RuntimeWarning, stacklevel=2)
+                    bypassed.append(b)
                     continue
                 if cap > 0:
                     self.caches[b] = HotRowCache(
                         emb, b, cap, promote_threshold=promote_threshold)
+        if bypassed:
+            # ONE construction-time warning for the lot (ISSUE 16
+            # satellite): per-bucket warnings drowned in fleet-sized
+            # runs, and the unrealized capacity win was invisible to
+            # dashboards — the gauge makes it addressable
+            import warnings
+            warnings.warn(
+                f"serving cache skipped for quantized bucket(s) "
+                f"{bypassed}: they store "
+                f"{sorted({emb._bucket_store_dtype(b) for b in bypassed})} "
+                "rows and the cache has no decode seam; requests fall "
+                "back to the decoded host lookup "
+                "(serve/cache_bypassed_buckets counts them)",
+                RuntimeWarning, stacklevel=2)
+        self._metrics.gauge("serve/cache_bypassed_buckets",
+                            **self._labels).set(len(bypassed))
         self._warmed: List[int] = []
         self._jit_fwd = jax.jit(
             self._fwd, donate_argnums=(1,) if donate_batch else ())
@@ -341,9 +362,10 @@ class InferenceEngine:
         self.n_predicts += 1
         self.rows_served += b
         self.rows_padded += target - b
-        self._metrics.counter("serve/predicts").inc()
-        self._metrics.counter("serve/rows_served").inc(b)
-        self._metrics.counter("serve/rows_padded").inc(target - b)
+        self._metrics.counter("serve/predicts", **self._labels).inc()
+        self._metrics.counter("serve/rows_served", **self._labels).inc(b)
+        self._metrics.counter("serve/rows_padded",
+                              **self._labels).inc(target - b)
         if self.store.version > self._lineage_served_version:
             # lineage (ISSUE 14): the FIRST predict answered at >= V
             # closes version V's async track — commit -> publish ->
@@ -439,6 +461,37 @@ class InferenceEngine:
         return sum(cache.refresh_from(self.store)
                    for cache in self.caches.values())
 
+    def reanchor_published(self, publish_dir: str,
+                           upto: Optional[int] = None) -> int:
+        """Rebuild the tables from the publish stream — the newest
+        snapshot at or below `upto` plus every chained delta after it —
+        and swap them in with a full cache refresh. The fleet tier's
+        rollback / re-anchor primitive (ISSUE 16): a canary that applied
+        a bad version returns to the pinned one; a late joiner
+        materializes the fleet's serving state in one shot. Unlike a
+        bare `set_params`, the store re-joins the PUBLISHER's version
+        number space afterwards (chain intact): the next poll chains
+        deltas from the restored version instead of waiting for a fresh
+        snapshot. Returns the restored version. Raises when the stream
+        holds no snapshot at or below `upto` — callers on a never-raise
+        path guard it (`FleetRouter` falls back to an in-memory pin)."""
+        from distributed_embeddings_tpu.store import restore_from_published
+        restored = restore_from_published(self.embedding, publish_dir,
+                                          upto=upto)
+        if self._model is None:
+            self.params = restored.params
+        else:
+            self.params = {**self.params, "embedding": restored.params}
+        self.store.replace(self._emb_params(self.params))
+        # replace() bumped into a local version space and broke the
+        # chain; the restored state IS publisher version
+        # `restored.version`, so adopt its numbering wholesale
+        self.store.version = restored.version
+        self.store.table_versions = list(restored.table_versions)
+        self.store._chain_broken = False
+        self.refresh()
+        return restored.version
+
     def apply_delta(self, path: str) -> dict:
         """Consume one published stream file (row delta or snapshot) in
         place: the store applies it to the tables (HBM scatter / host
@@ -450,11 +503,15 @@ class InferenceEngine:
         self._absorb_apply(info)
         return info
 
-    def poll_updates(self, publish_dir: str) -> List[dict]:
+    def poll_updates(self, publish_dir: str,
+                     upto: Optional[int] = None) -> List[dict]:
         """Apply every new stream file a training job has published into
         `publish_dir` (chain order; snapshot fallback), patching caches
         per file. Returns the applied infos; `update_stats(publish_dir)`
-        exposes the consumer's staleness accounting.
+        exposes the consumer's staleness accounting. `upto` caps the
+        poll at a version ceiling (fleet canary pinning, ISSUE 16):
+        newer files stay invisible and a replica held at the ceiling
+        reads as caught up, not stale.
 
         NEVER raises on consumer-side faults (ISSUE 13): corrupt files
         quarantine inside `DeltaConsumer.poll`; anything that still
@@ -477,7 +534,7 @@ class InferenceEngine:
         reasons = set()
         infos: List[dict] = []
         try:
-            infos = consumer.poll()
+            infos = consumer.poll(upto=upto)
             for info in infos:
                 if "cache_patch" in reasons:
                     break            # full refresh below covers the rest
@@ -524,9 +581,11 @@ class InferenceEngine:
                 reasons.add("vocab_sidecar")
         reasons |= consumer.degraded_reasons()
         for r in reasons:
-            self._metrics.gauge("serve/degraded", reason=r).set(1)
+            self._metrics.gauge("serve/degraded", reason=r,
+                                **self._labels).set(1)
         for r in self._degraded_active - reasons:
-            self._metrics.gauge("serve/degraded", reason=r).set(0)
+            self._metrics.gauge("serve/degraded", reason=r,
+                                **self._labels).set(0)
         entered = frozenset(reasons) - self._degraded_active
         self._degraded_active = frozenset(reasons)
         if entered:
@@ -559,7 +618,8 @@ class InferenceEngine:
 
     def _note_poll_error(self, e: BaseException) -> None:
         self.last_poll_error = f"{type(e).__name__}: {e}"[:300]
-        self._metrics.counter("serve/poll_errors_total").inc()
+        self._metrics.counter("serve/poll_errors_total",
+                              **self._labels).inc()
 
     def degraded_reasons(self) -> frozenset:
         """The reasons currently holding this engine in degraded mode

@@ -865,9 +865,17 @@ class DeltaConsumer:
         self._lineage_scanned: set = set()
 
     # ------------------------------------------------------------ internals
-    def _visible(self) -> List[Tuple[int, str, str]]:
+    def _visible(self, upto: Optional[int] = None
+                 ) -> List[Tuple[int, str, str]]:
         self._last_scan = scan_published(self.directory)
-        return [f for f in self._last_scan if f[2] not in self.quarantined]
+        files = [f for f in self._last_scan if f[2] not in self.quarantined]
+        if upto is not None:
+            # version ceiling (fleet canary pinning): files beyond the
+            # ceiling stay out of the view — NOT out of `_last_scan`,
+            # whose bookkeeping (meta-cache eviction, quarantine GC)
+            # must keep tracking the whole live stream
+            files = [f for f in files if f[0] <= upto]
+        return files
 
     def _quarantine(self, path: str, err: BaseException) -> None:
         reason = f"{type(err).__name__}: {err}"
@@ -986,14 +994,20 @@ class DeltaConsumer:
         poll ends fully caught up."""
         return frozenset(self._degraded)
 
-    def poll(self) -> List[dict]:
+    def poll(self, upto: Optional[int] = None) -> List[dict]:
         """Apply every applicable published file. Returns the applied
         infos (possibly empty). Never raises on corrupt or transiently
         unreadable stream files (see class docstring); the
         ``consumer.poll`` fault point can inject a transient error at
-        entry (exercising the engine-level degradation path)."""
+        entry (exercising the engine-level degradation path).
+
+        `upto` caps consumption at a version ceiling: files above it are
+        invisible to this poll, and staleness/health accounting is
+        measured against the ceiling, not the stream head — a replica
+        pinned at a rollout's last-promoted version is CAUGHT UP, not
+        degraded, while newer unvetted versions accumulate."""
         faults.check_raise("consumer.poll", directory=self.directory)
-        files = self._visible()
+        files = self._visible(upto)
         # lineage (ISSUE 14): the first time this consumer's directory
         # scan SEES a not-yet-applied version, stamp it on the
         # version's async track — the scan->apply gap is the consumer
@@ -1009,8 +1023,10 @@ class DeltaConsumer:
             # healthy only if nothing newer exists even among the
             # quarantined files (a quarantined NEWER file means serving
             # is genuinely behind the publisher: stay degraded until
-            # the re-anchoring snapshot arrives)
-            if not any(f[0] > self.store.version for f in self._last_scan):
+            # the re-anchoring snapshot arrives); under a ceiling,
+            # "newer" means newer WITHIN the ceiling
+            if not any(f[0] > self.store.version for f in self._last_scan
+                       if upto is None or f[0] <= upto):
                 self._degraded.clear()
             return []
         if newer:
@@ -1022,9 +1038,11 @@ class DeltaConsumer:
         out = []
         latest_seen = self.store.version
         while True:
-            files = self._visible()
-            if self._last_scan:
-                latest_seen = max(latest_seen, self._last_scan[-1][0])
+            files = self._visible(upto)
+            capped = [f for f in self._last_scan
+                      if upto is None or f[0] <= upto]
+            if capped:
+                latest_seen = max(latest_seen, capped[-1][0])
             nxt = self._choose(files)
             if nxt is None:
                 break

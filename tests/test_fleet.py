@@ -1,0 +1,384 @@
+"""Serving fleet tier (ISSUE 16): consistent-hash routing, admission
+control, elastic membership, canaried rollout.
+
+Acceptance contract: (a) the ring is process-independent and moves ONLY
+the affected key ranges on membership change (bounded movement, asserted
+exactly); (b) overload returns typed shed results — the serve path never
+raises — without disturbing the admitted requests' latency accounting;
+(c) replicas join/leave mid-traffic without an exception, and a joiner
+enters rotation only once caught up to the pinned version; (d) a
+published version serves fleet-wide only after the canaries report
+bit-exact parity (0.0 f32) against the publisher, a corrupted canary
+apply rolls the fleet back to the pinned version leaving a
+flight-recorder event, and later versions promote THROUGH the condemned
+one over the same on-disk stream.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from distributed_embeddings_tpu import faults, obs
+from distributed_embeddings_tpu.fleet import (AdmissionController,
+                                              FleetRouter, HashRing,
+                                              RouteResult, stable_hash64)
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    DistributedEmbedding)
+from distributed_embeddings_tpu.layers.embedding import Embedding
+from distributed_embeddings_tpu.parallel.mesh import create_mesh
+from distributed_embeddings_tpu.serving import InferenceEngine
+from distributed_embeddings_tpu.store import TableStore
+
+SPECS = [(600, 8, "sum"), (600, 8, "sum")]
+
+
+# --------------------------------------------------------------- hash ring
+def test_stable_hash_is_process_independent():
+    """blake2b, not the salted builtin hash(): fixed values pin the
+    function across processes and releases — a drifting hash silently
+    remaps every key and voids cache affinity."""
+    assert stable_hash64("r0#0") == stable_hash64("r0#0")
+    assert stable_hash64(7) == stable_hash64(np.int64(7))
+    assert stable_hash64(7) != stable_hash64("7")  # ints hash as bytes
+    # pinned sample: fails if the construction ever changes silently
+    assert stable_hash64("replica-a") == 0xD873391571CC4E3A
+
+
+def test_ring_routes_deterministically_and_covers():
+    ring = HashRing(vnodes=64)
+    for n in ("a", "b", "c", "d"):
+        ring.add(n)
+    keys = range(2000)
+    assign = ring.assignments(keys)
+    # stable: a second pass routes identically
+    assert assign == ring.assignments(keys)
+    counts = {n: 0 for n in ring.nodes()}
+    for owner in assign.values():
+        counts[owner] += 1
+    assert all(c > 0 for c in counts.values())          # coverage
+    assert max(counts.values()) < 3 * min(counts.values())  # vnode balance
+
+
+def test_ring_bounded_movement_on_join_and_leave():
+    """THE consistent-hashing property: adding a node moves keys only
+    INTO it; removing moves only ITS keys; add+remove round-trips the
+    whole assignment map exactly."""
+    ring = HashRing(vnodes=64)
+    for n in ("a", "b", "c"):
+        ring.add(n)
+    keys = list(range(2000))
+    before = ring.assignments(keys)
+
+    ring.add("d")
+    with_d = ring.assignments(keys)
+    moved = [k for k in keys if with_d[k] != before[k]]
+    assert moved, "a new node must take some load"
+    assert all(with_d[k] == "d" for k in moved)
+    # ~1/4 of keys move, never the modulo-router's ~3/4
+    assert len(moved) < len(keys) / 2
+
+    ring.remove("d")
+    assert ring.assignments(keys) == before   # exact round-trip
+    ring.remove("b")
+    after = ring.assignments(keys)
+    for k in keys:
+        if before[k] != "b":
+            assert after[k] == before[k]      # only b's keys moved
+        else:
+            assert after[k] in ("a", "c")
+
+
+def test_ring_add_is_idempotent_and_empty_routes_none():
+    ring = HashRing(vnodes=8)
+    assert ring.route(1) is None
+    ring.add("a")
+    ring.add("a")
+    assert len(ring) == 1 and "a" in ring
+    assert ring.route(123) == "a"
+
+
+# ---------------------------------------------------------------- admission
+class _FakeBatcher:
+    def __init__(self, depth, rows):
+        self.queue_depth = depth
+        self.queued_rows = rows
+
+
+def test_admission_sheds_typed_on_depth_and_rows():
+    adm = AdmissionController(max_queue_depth=4, max_queue_rows=100)
+    assert adm.shed_reason(_FakeBatcher(0, 0), 16) is None
+    assert adm.shed_reason(_FakeBatcher(4, 0), 16) == "queue_depth"
+    assert adm.shed_reason(_FakeBatcher(1, 90), 16) == "queue_rows"
+    assert adm.shed_reason(_FakeBatcher(1, 84), 16) is None
+    # rows cap optional
+    assert AdmissionController(4).shed_reason(
+        _FakeBatcher(1, 10 ** 9), 16) is None
+
+
+def test_admission_env_defaults(monkeypatch):
+    monkeypatch.setenv("DET_FLEET_MAX_QUEUE_DEPTH", "7")
+    monkeypatch.setenv("DET_FLEET_MAX_QUEUE_ROWS", "33")
+    adm = AdmissionController()
+    assert adm.max_queue_depth == 7 and adm.max_queue_rows == 33
+
+
+def test_route_result_truthiness():
+    ok = RouteResult(True, replica="r0", handle=3, key=9)
+    shed = RouteResult(False, shed_reason="queue_depth", key=9)
+    assert ok and not shed
+    assert shed.shed_reason == "queue_depth"
+    assert "queue_depth" in repr(shed) and "r0" in repr(ok)
+
+
+# ------------------------------------------------------------- fleet rig
+def _build():
+    mesh = create_mesh(jax.devices()[:8])
+    # gpu_embedding_size=1 host-offloads every bucket: the serving-tier
+    # memory shape, and the HotRowCache is in the predict path
+    return DistributedEmbedding(
+        [Embedding(v, w, combiner=c) for v, w, c in SPECS],
+        mesh=mesh, gpu_embedding_size=1)
+
+
+def _mk_engine(reg, name, seed=0):
+    emb = _build()
+    zeros = [np.zeros((v, w), np.float32) for v, w, _ in SPECS]
+    return InferenceEngine(emb, emb.set_weights(zeros),
+                           cache_capacity=64, registry=reg, replica=name)
+
+
+@pytest.fixture()
+def pub(tmp_path):
+    """A publisher with three clean published versions (all forced
+    snapshots so each version carries full bytes)."""
+    rng = np.random.RandomState(0)
+    emb = _build()
+    w1 = [rng.randn(v, w).astype(np.float32) * 0.1 for v, w, _ in SPECS]
+    store = TableStore(emb, emb.set_weights(w1), snapshot_every=2)
+    d = str(tmp_path / "pub")
+    versions = {}
+    for k in range(3):
+        wk = [t + 0.25 * k for t in w1]
+        store.commit(emb.set_weights(wk), None)
+        store.publish(d, force_snapshot=True)
+        versions[store.version] = wk
+    return emb, store, d, versions
+
+
+def _fleet(reg, store, d, n=3, **kw):
+    kw.setdefault("admission", AdmissionController(max_queue_depth=4))
+    kw.setdefault("reference_weights", lambda v: store.get_weights())
+    router = FleetRouter(d, registry=reg, vnodes=32, canaries=1, **kw)
+    for i in range(n):
+        router.add_replica(f"r{i}", _mk_engine(reg, f"r{i}"))
+    return router
+
+
+def _req(key, rows=4):
+    ids = np.full((rows, 2), (key * 37) % 600, np.int64)
+    return [(ids + t) % 600 for t in range(len(SPECS))]
+
+
+# ----------------------------------------------------------------- rollout
+def test_promote_requires_bitexact_parity_and_fleet_converges(pub):
+    emb, store, d, versions = pub
+    reg = obs.MetricRegistry()
+    obs.reset_default_recorder()
+    router = _fleet(reg, store, d)
+    ev = router.step()["event"]
+    assert ev["event"] == "promote"
+    assert ev["parity_devs"] == [0.0]          # bit-exact, not approx
+    assert router.pinned_version == store.version
+    # EVERY serving member (not just the canary) is at the promoted
+    # version with the publisher's exact bytes
+    want = [np.asarray(t) for t in store.get_weights()]
+    for m in router._members.values():
+        assert m.state == "serving"
+        assert int(m.engine.store.version) == store.version
+        for a, b in zip(want, m.engine.store.get_weights()):
+            np.testing.assert_array_equal(a, np.asarray(b))
+    names = {e[1] for e in obs.default_recorder().events()}
+    assert "fleet/canary_promote" in names
+
+
+def test_corrupt_canary_rolls_back_and_next_version_promotes(pub):
+    """The rollout acceptance chain: a bit-flipped canary apply condemns
+    the version (pin unchanged, canary re-anchored, recorder event), the
+    condemned version NEVER serves fleet-wide, and the next clean
+    version promotes through the same on-disk files."""
+    emb, store, d, versions = pub
+    reg = obs.MetricRegistry()
+    obs.reset_default_recorder()
+    router = _fleet(reg, store, d)
+    assert router.step()["event"]["event"] == "promote"
+    v1 = router.pinned_version
+
+    plan = faults.FaultPlan.from_json({"seed": 3, "faults": [
+        {"point": "fleet.canary_apply", "kind": "bit_flip", "at": [0]}]})
+    with faults.use_plan(plan):
+        w_next = [np.asarray(t) + 1.5 for t in store.get_weights()]
+        store.commit(emb.set_weights(w_next), None)
+        store.publish(d, force_snapshot=True)
+        bad = store.version
+        ev = router.step()["event"]
+    assert ev["event"] == "rollback" and ev["version"] == bad
+    assert ev["parity_devs"][0] == pytest.approx(1.0)   # the injected flip
+    assert router.pinned_version == v1
+    assert bad in router.rollout.bad_versions
+    # containment: every member is back at (or still at) the pin
+    for m in router._members.values():
+        assert int(m.engine.store.version) == v1
+        assert int(m.engine.store.version) not in router.rollout.bad_versions
+    names = {e[1] for e in obs.default_recorder().events()}
+    assert "fleet/canary_rollback" in names
+    # a condemned version is never retried...
+    assert router.step()["event"] is None
+    # ...but the NEXT version promotes through the same stream, and the
+    # whole fleet lands bit-exact on it
+    w_good = [np.asarray(t) + 0.125 for t in store.get_weights()]
+    store.commit(emb.set_weights(w_good), None)
+    store.publish(d, force_snapshot=True)
+    ev = router.step()["event"]
+    assert ev["event"] == "promote" and ev["version"] == store.version
+    assert ev["parity_devs"] == [0.0]
+    for m in router._members.values():
+        for a, b in zip(store.get_weights(), m.engine.store.get_weights()):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert reg.counter("fleet/rollbacks_total").value == 1
+    assert reg.counter("fleet/promotes_total").value == 2
+
+
+def test_step_idle_when_fully_rolled_out(pub):
+    """With everything promoted there is no candidate: the control tick
+    is a no-op (no event, no condemnation, no spurious polls)."""
+    emb, store, d, versions = pub
+    reg = obs.MetricRegistry()
+    router = _fleet(reg, store, d)
+    router.step()
+    assert router.rollout.candidate() is None
+    assert router.step()["event"] is None
+    assert router.errors == []
+
+
+# -------------------------------------------------------- routing + sheds
+def test_routing_covers_fleet_and_affinity_holds(pub):
+    emb, store, d, versions = pub
+    reg = obs.MetricRegistry()
+    router = _fleet(reg, store, d)
+    router.step()
+    owners = {}
+    for key in range(64):
+        r = router.submit(_req(key), key=key)
+        assert r.accepted, r
+        owners[key] = r.replica
+        router.flush()
+    assert set(owners.values()) == {"r0", "r1", "r2"}   # coverage
+    for key in range(64):                               # affinity
+        r = router.submit(_req(key), key=key)
+        assert r.replica == owners[key]
+        router.flush()
+
+
+def test_overload_sheds_typed_and_latency_accounting_clean(pub):
+    """Burst past max_queue_depth: sheds are typed RouteResults (never
+    an exception), and the latency histogram counts EXACTLY the admitted
+    requests — a shed must not leave a phantom latency sample."""
+    emb, store, d, versions = pub
+    reg = obs.MetricRegistry()
+    router = _fleet(reg, store, d)
+    router.step()
+    accepted, shed = [], []
+    for i in range(12):                    # one key -> one replica's queue
+        r = router.submit(_req(99), key=99)
+        (accepted if r else shed).append(r)
+    assert len(accepted) == 4              # max_queue_depth
+    assert {s.shed_reason for s in shed} == {"queue_depth"}
+    assert all(s.replica == accepted[0].replica for s in shed)
+    out = router.flush()
+    assert set(out) == {r.handle for r in accepted}
+    h = reg.histogram("serve/request_seconds",
+                      replica=accepted[0].replica)
+    assert h.count == len(accepted)
+    assert reg.counter("fleet/shed_total", reason="queue_depth").value \
+        == len(shed)
+    assert router.errors == []
+
+
+def test_submit_with_no_replicas_sheds_typed():
+    reg = obs.MetricRegistry()
+    router = FleetRouter("/nonexistent", registry=reg)
+    r = router.submit([np.zeros((2, 2), np.int32)], key=1)
+    assert not r and r.shed_reason == "no_replicas"
+
+
+def test_oversize_request_sheds_typed(pub):
+    emb, store, d, versions = pub
+    reg = obs.MetricRegistry()
+    router = _fleet(reg, store, d, max_batch=8)
+    router.step()
+    r = router.submit(_req(5, rows=9), key=5)
+    assert not r and r.shed_reason == "oversize"
+
+
+# ------------------------------------------------------ elastic membership
+def test_join_and_leave_mid_traffic_never_raise(pub):
+    emb, store, d, versions = pub
+    reg = obs.MetricRegistry()
+    router = _fleet(reg, store, d)
+    router.step()
+    pinned = router.pinned_version
+    for key in range(8):
+        router.submit(_req(key), key=key)
+    drained = router.remove_replica("r1")      # queued work drains
+    assert all(v is not None for v in drained.values())
+    for key in range(8, 16):
+        assert router.submit(_req(key), key=key).replica in ("r0", "r2")
+    # joiner catches up to the pin BEFORE entering rotation
+    router.add_replica("r9", _mk_engine(reg, "r9"))
+    m = router._members["r9"]
+    assert m.state == "serving"
+    assert int(m.engine.store.version) == pinned
+    router.flush()
+    assert router.errors == []
+    assert "r9" in router.ring and "r1" not in router.ring
+
+
+def test_duplicate_replica_name_raises_control_plane(pub):
+    emb, store, d, versions = pub
+    reg = obs.MetricRegistry()
+    router = _fleet(reg, store, d, n=1)
+    with pytest.raises(ValueError, match="already in the fleet"):
+        router.add_replica("r0", _mk_engine(reg, "r0"))
+
+
+# --------------------------------------------- poll(upto=) + reanchor seams
+def test_poll_upto_is_a_version_ceiling_not_degraded(pub):
+    """`upto=` pins a replica mid-stream: it reads as caught-up (healthy,
+    no degraded reason) at the ceiling even though newer files exist,
+    and a later uncapped poll drains the rest."""
+    emb, store, d, versions = pub
+    reg = obs.MetricRegistry()
+    eng = _mk_engine(reg, "pin")
+    vs = sorted(versions)
+    eng.poll_updates(d, upto=vs[1])
+    assert int(eng.store.version) == vs[1]
+    assert not eng.degraded_reasons()
+    np.testing.assert_array_equal(
+        np.asarray(eng.store.get_weights()[0]), versions[vs[1]][0])
+    eng.poll_updates(d)
+    assert int(eng.store.version) == vs[-1]
+
+
+def test_reanchor_published_adopts_publisher_version_space(pub):
+    emb, store, d, versions = pub
+    reg = obs.MetricRegistry()
+    eng = _mk_engine(reg, "re")
+    vs = sorted(versions)
+    got = eng.reanchor_published(d, upto=vs[0])
+    assert got == vs[0] and int(eng.store.version) == vs[0]
+    assert not eng.store._chain_broken
+    np.testing.assert_array_equal(
+        np.asarray(eng.store.get_weights()[0]), versions[vs[0]][0])
+    # and the stream continues from there without a re-anchor
+    eng.poll_updates(d)
+    assert int(eng.store.version) == vs[-1]
