@@ -1437,19 +1437,29 @@ def run_storedtype_bench(vocab: int = 6000, width: int = 128,
     from distributed_embeddings_tpu.parallel.mesh import create_mesh
     from distributed_embeddings_tpu.store import TableStore, scan_published
 
+    from distributed_embeddings_tpu.ops import (
+        sparse_update as sparse_update_ops)
+
     devs = jax.devices()
     if len(devs) < world:
         return {"skipped": f"need {world} devices, have {len(devs)}"}
     mesh = create_mesh(devs[:world])
-    # one big bucket past the device budget (the cold rows the codec
-    # exists for) + small device-resident tables (must stay f32 by the
-    # eligibility gate)
     specs = [(vocab, width, "sum")] + [(64 + i, width, "sum")
                                        for i in range(tables - 1)]
-    budget = (vocab * width) // 2
+    # Two residencies per dtype (ISSUE 17): the 'offload' arms put the
+    # big bucket past the device budget (cold rows, host-exchange
+    # decode + touched-rows host apply), the '_hbm' arms run with NO
+    # budget so every bucket stays device-resident (decode at gather
+    # inside the jitted step, master-weight-free row update). adam has
+    # no master-weight-free rule — its quantized arms must offload
+    # EVERYTHING (budget 1) and the HBM arms are skipped on record.
+    hbm_ok = optimizer in sparse_update_ops.QUANTIZED_ROW_KINDS
+    budget_off = (vocab * width) // 2 if hbm_ok else 1
+    residencies = ([("", budget_off), ("_hbm", None)] if hbm_ok
+                   else [("", budget_off)])
 
     class _M:
-        def __init__(self, sd):
+        def __init__(self, sd, budget):
             self.embedding = DistributedEmbedding(
                 [Embedding(v, w, combiner=c) for v, w, c in specs],
                 mesh=mesh, gpu_embedding_size=budget, storage_dtype=sd)
@@ -1475,89 +1485,131 @@ def run_storedtype_bench(vocab: int = 6000, width: int = 128,
     labels = jnp.asarray(rng.randn(batch).astype(np.float32))
 
     dtypes = ["f32", "int8"] + (["fp8"] if wire_ops.fp8_supported() else [])
+
+    def resident_bytes(p):
+        tot = sum(int(leaf.size) * leaf.dtype.itemsize
+                  for leaf in p["tp"])
+        for leaf in (p.get("tp_scale") or []):
+            if leaf is not None:
+                tot += int(leaf.size) * leaf.dtype.itemsize
+        return tot
+
     arms, trained = {}, {}
-    for sd in dtypes:
-        model = _M(sd)
-        emb = model.embedding
-        assert emb.quantized_buckets == ([0] if sd != "f32" else []), \
-            "storedtype bench: offload/eligibility drifted"
-        init_fn, step_fn = make_sparse_train_step(
-            model, optimizer, lr=0.05, donate=False)
-        params = {"embedding": emb.set_weights(weights0)}
-        state = init_fn(params)
-        store = TableStore(emb, params["embedding"], delta_dtype=sd)
-        pub_dir = tempfile.mkdtemp(prefix=f"storedtype_{sd}_")
-        snap_info = store.publish(pub_dir)          # the anchor
-        t0 = time.perf_counter()
-        for s in range(steps):
-            store.observe(data[s])
-            params, state, loss = step_fn(params, state, num, data[s],
-                                          labels)
-        jax.block_until_ready(params["embedding"]["tp"][0])
-        dt = time.perf_counter() - t0
-        store.commit(params["embedding"], state["emb"])
-        delta_info = store.publish(pub_dir)
-        # consume into a fresh replica and compare merged weights
-        c_emb = _M(sd).embedding
-        consumer = TableStore(c_emb, c_emb.init(jax.random.PRNGKey(1)))
-        for _, _, path in scan_published(pub_dir):
-            consumer.apply_published(path)
-        pub_w = emb.get_weights(params["embedding"])
-        con_w = consumer.get_weights()
-        parity = max(float(np.abs(a - b).max())
-                     for a, b in zip(pub_w, con_w))
-        trained[sd] = pub_w
-        table0 = params["embedding"]["tp"][0]
-        scale0 = (params["embedding"]["tp_scale"][0]
-                  if sd != "f32" else None)
-        arms[sd] = {
-            "storage_dtype": sd,
-            "snapshot_payload_bytes": snap_info["payload_bytes"],
-            "snapshot_model_bytes": snap_info["model_payload_bytes"],
-            "delta_payload_bytes": delta_info["payload_bytes"],
-            "delta_model_bytes": delta_info["model_payload_bytes"],
-            "snapshot_file_bytes": snap_info["bytes"],
-            "delta_file_bytes": delta_info["bytes"],
-            "delta_rows": delta_info["rows"],
-            "bucket_resident_bytes": int(
-                table0.size * table0.dtype.itemsize
-                + (0 if scale0 is None
-                   else scale0.size * scale0.dtype.itemsize)),
-            "payload_model_reconciled": (
-                snap_info["payload_bytes"] == snap_info[
-                    "model_payload_bytes"]
-                and delta_info["payload_bytes"] == delta_info[
-                    "model_payload_bytes"]),
-            "publish_consume_parity_max_dev": parity,
-            "steps_per_sec": round(steps / dt, 3),
-        }
+    for suffix, budget in residencies:
+        for sd in dtypes:
+            name = sd + suffix
+            model = _M(sd, budget)
+            emb = model.embedding
+            offl = [b for b in range(len(emb.plan.tp_buckets))
+                    if emb.plan.tp_buckets[b].offload]
+            if sd != "f32":
+                # the lifted gate: every bucket quantizes, and the
+                # residency split is exactly what the budget asked for
+                assert emb.quantized_buckets == list(
+                    range(len(emb.plan.tp_buckets))), \
+                    "storedtype bench: eligibility drifted"
+                assert (offl == [] if suffix == "_hbm"
+                        else offl != []), \
+                    "storedtype bench: residency drifted"
+            init_fn, step_fn = make_sparse_train_step(
+                model, optimizer, lr=0.05, donate=False)
+            params = {"embedding": emb.set_weights(weights0)}
+            state = init_fn(params)
+            store = TableStore(emb, params["embedding"], delta_dtype=sd)
+            pub_dir = tempfile.mkdtemp(prefix=f"storedtype_{name}_")
+            snap_info = store.publish(pub_dir)          # the anchor
+            t0 = time.perf_counter()
+            for s in range(steps):
+                store.observe(data[s])
+                params, state, loss = step_fn(params, state, num,
+                                              data[s], labels)
+            jax.block_until_ready(params["embedding"]["tp"][0])
+            dt = time.perf_counter() - t0
+            store.commit(params["embedding"], state["emb"])
+            delta_info = store.publish(pub_dir)
+            # consume into a fresh replica and compare merged weights
+            c_emb = _M(sd, budget).embedding
+            consumer = TableStore(c_emb, c_emb.init(jax.random.PRNGKey(1)))
+            for _, _, path in scan_published(pub_dir):
+                consumer.apply_published(path)
+            pub_w = emb.get_weights(params["embedding"])
+            con_w = consumer.get_weights()
+            parity = max(float(np.abs(a - b).max())
+                         for a, b in zip(pub_w, con_w))
+            trained[name] = pub_w
+            arms[name] = {
+                "storage_dtype": sd,
+                "residency": ("device" if suffix == "_hbm" else "offload"),
+                "snapshot_payload_bytes": snap_info["payload_bytes"],
+                "snapshot_model_bytes": snap_info["model_payload_bytes"],
+                "delta_payload_bytes": delta_info["payload_bytes"],
+                "delta_model_bytes": delta_info["model_payload_bytes"],
+                "snapshot_file_bytes": snap_info["bytes"],
+                "delta_file_bytes": delta_info["bytes"],
+                "delta_rows": delta_info["rows"],
+                "bucket_resident_bytes": resident_bytes(
+                    params["embedding"]),
+                "quantized_rows_applied": emb.quantized_rows_applied_total,
+                "quantized_apply_bytes": emb.quantized_apply_bytes_total,
+                "payload_model_reconciled": (
+                    snap_info["payload_bytes"] == snap_info[
+                        "model_payload_bytes"]
+                    and delta_info["payload_bytes"] == delta_info[
+                        "model_payload_bytes"]),
+                "publish_consume_parity_max_dev": parity,
+                "steps_per_sec": round(steps / dt, 3),
+            }
+            if sd != "f32" and suffix == "":
+                # touched-rows host apply accounting: layer totals must
+                # reconcile EXACTLY through wire.delta_row_bytes
+                a = arms[name]
+                a["apply_bytes_reconciled"] = (
+                    a["quantized_apply_bytes"]
+                    == a["quantized_rows_applied"]
+                    * wire_ops.delta_row_bytes(width, sd))
     f32 = arms["f32"]
     record = {
         "metric": "storedtype_stream_ab", "vocab": vocab, "width": width,
         "tables": tables, "batch": batch, "steps": steps, "world": world,
         "optimizer": optimizer, "arms": arms,
+        "hbm_arms_skipped": (None if hbm_ok else
+                             f"{optimizer} has no master-weight-free "
+                             "quantized row-update rule"),
         "storedtype_parity_f32": f32["publish_consume_parity_max_dev"],
     }
-    for sd in dtypes[1:]:
-        a = arms[sd]
-        a["delta_payload_reduction"] = round(
-            f32["delta_payload_bytes"] / a["delta_payload_bytes"], 3)
-        a["snapshot_payload_reduction"] = round(
-            f32["snapshot_payload_bytes"] / a["snapshot_payload_bytes"], 3)
-        a["bucket_bytes_reduction"] = round(
-            f32["bucket_resident_bytes"] / a["bucket_resident_bytes"], 3)
-        # trained-table deviation vs the f32 arm: the SR write-back
-        # convergence claim at this shape (bounded, not bit-exact)
-        a["train_table_max_dev_vs_f32"] = max(
-            float(np.abs(x - y).max())
-            for x, y in zip(trained["f32"], trained[sd]))
+    quant_arms = []
+    for suffix, _ in residencies:
+        base = arms["f32" + suffix]
+        for sd in dtypes[1:]:
+            name = sd + suffix
+            quant_arms.append(name)
+            a = arms[name]
+            a["delta_payload_reduction"] = round(
+                base["delta_payload_bytes"] / a["delta_payload_bytes"], 3)
+            a["snapshot_payload_reduction"] = round(
+                base["snapshot_payload_bytes"]
+                / a["snapshot_payload_bytes"], 3)
+            # vs the f32 twin at the SAME residency: for the _hbm arms
+            # this is the ~4x rows-per-HBM-byte claim itself
+            a["bucket_bytes_reduction"] = round(
+                base["bucket_resident_bytes"]
+                / a["bucket_resident_bytes"], 3)
+            # trained-table deviation vs the f32 twin: the SR write-back
+            # convergence claim at this shape (bounded, not bit-exact)
+            a["train_table_max_dev_vs_f32"] = max(
+                float(np.abs(x - y).max())
+                for x, y in zip(trained["f32" + suffix], trained[name]))
     record["min_payload_reduction_required"] = 3.5
     record["over_bound"] = bool(
-        f32["publish_consume_parity_max_dev"] != 0.0
-        or not all(arms[sd]["payload_model_reconciled"] for sd in dtypes)
-        or any(arms[sd]["delta_payload_reduction"] < 3.5
-               or arms[sd]["snapshot_payload_reduction"] < 3.5
-               for sd in dtypes[1:]))
+        any(arms["f32" + s]["publish_consume_parity_max_dev"] != 0.0
+            for s, _ in residencies)
+        or not all(a["payload_model_reconciled"] for a in arms.values())
+        or not all(arms[n].get("apply_bytes_reconciled", True)
+                   for n in quant_arms)
+        or any(arms[n]["delta_payload_reduction"] < 3.5
+               or arms[n]["snapshot_payload_reduction"] < 3.5
+               or arms[n]["bucket_bytes_reduction"] < 3.5
+               for n in quant_arms))
     return record
 
 
@@ -3266,12 +3318,27 @@ def _run_fleet_bench_inner(scenario: dict, pub_dir: str) -> dict:
 
     # ---- hit rate vs fleet size: fresh sub-fleets replay ONE keyed
     # stream (same seed per size) so the only variable is how many
-    # replicas split the key space over the same per-replica cache
-    def hit_rate_at(size: int) -> dict:
+    # replicas split the key space over the same per-replica cache.
+    # Replayed twice: at the fleet's f32 storage and over int8-quantized
+    # buckets (ISSUE 17 — the HotRowCache decode seam keeps the cache in
+    # the serve path for quantized tables: slots hold decoded f32 rows,
+    # misses decode payload x scale in the same host-compute region, and
+    # serve/cache_bypassed_buckets must stay 0).
+    def hit_rate_at(size: int, storage_dtype=None) -> dict:
         ring = HashRing(int(fl["vnodes"]))
         engs = {}
         for i in range(size):
-            e = make_replica(900 + i)
+            if storage_dtype is None:
+                e = make_replica(900 + i)
+            else:
+                qemb = _ha._build_model(
+                    vocab_rows, width, "sum", tables=tables, mesh=mesh,
+                    gpu_embedding_size=gpu_budget,
+                    storage_dtype=storage_dtype).embedding
+                e = InferenceEngine(
+                    qemb, qemb.init(jax.random.PRNGKey(seed + 900 + i)),
+                    cache_capacity=int(fl["cache_capacity"]),
+                    registry=reg, replica=f"q{i}")
             e.poll_updates(pub_dir)        # re-anchor on the recovery
             name = f"s{i}"
             ring.add(name)
@@ -3291,6 +3358,11 @@ def _run_fleet_bench_inner(scenario: dict, pub_dir: str) -> dict:
                 if hits + misses else 0.0}
 
     hit_curve = [hit_rate_at(int(s)) for s in fl["fleet_sizes"]]
+    hit_curve_q = [hit_rate_at(int(s), storage_dtype="int8")
+                   for s in fl["fleet_sizes"]]
+    cache_bypassed = max(
+        (v for k, v in reg.snapshot()["gauges"].items()
+         if k.startswith("serve/cache_bypassed_buckets")), default=0.0)
 
     # ---- latency: per-replica histograms + the fleet-wide merge (the
     # UNLABELED serve/request_seconds family = the whole fleet, so the
@@ -3328,6 +3400,8 @@ def _run_fleet_bench_inner(scenario: dict, pub_dir: str) -> dict:
         "fleet_serve_p99_ms": fleet_summ["p99_ms"],
         "fleet_replica_latency": per_replica,
         "fleet_hit_rate_curve": hit_curve,
+        "fleet_hit_rate_curve_quantized": hit_curve_q,
+        "fleet_cache_bypassed_buckets": cache_bypassed,
         "fleet_canary_events": router.rollout.events[:50],
         "fleet_promotes": stats["promotes"],
         "fleet_rollbacks": stats["rollbacks"],
@@ -3351,6 +3425,7 @@ def _run_fleet_bench_inner(scenario: dict, pub_dir: str) -> dict:
 
     # the SLO-addressable acceptance gauges (tools/slo_soak.json)
     reg.gauge("fleet/parity_max_dev").set(parity)
+    reg.gauge("fleet/cache_bypassed_buckets").set(cache_bypassed)
     reg.gauge("fleet/idle_sheds").set(idle_sheds)
     reg.gauge("fleet/replicas_unrouted").set(replicas_unrouted)
     reg.gauge("fleet/bad_version_served").set(bad_served)

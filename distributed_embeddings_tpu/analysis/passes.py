@@ -349,12 +349,31 @@ def storage_dtype_pass(mod: ir.Module, ctx: PlanContext) -> List[Finding]:
     allowed = {d for s in ctx.storage_dtypes
                for d in wire_ops.seam_storage_dtypes(s)}
     hits: Dict[Tuple[str, str], List[ir.Instruction]] = {}
+    present: set = set()
     for _, inst in mod.walk():
         for t in inst.operand_types + inst.result_types:
-            if t.dtype in ir.QUANTIZED_STORAGE_DTYPES \
-                    and t.dtype not in allowed:
-                hits.setdefault((t.dtype, inst.kind), []).append(inst)
+            if t.dtype in ir.QUANTIZED_STORAGE_DTYPES:
+                present.add(t.dtype)
+                if t.dtype not in allowed:
+                    hits.setdefault((t.dtype, inst.kind), []).append(inst)
     out: List[Finding] = []
+    # ---- inverse direction (ISSUE 17, HBM-resident buffers): a plan
+    # that DECLARES a quantized storage dtype whose seam element type
+    # appears in NO buffer of the lowered program. The declaration was
+    # dropped on the floor — the table lowered as plain f32, so the
+    # promised ~4x HBM saving silently never materialized (the mirror
+    # failure of the undeclared case; both directions are blind-gated
+    # by tools/hlo_audit.py mutation fixtures).
+    for dtype in sorted(allowed - present):
+        out.append(Finding(
+            pass_name="storage-dtype",
+            fid=f"storage-dtype/declared-but-f32.{dtype}",
+            severity="error", op="module",
+            message=(f"plan declares a storage dtype lowering to {dtype} "
+                     f"(declared: {sorted(ctx.storage_dtypes)}) but no op "
+                     f"in the program carries {dtype} values — the bucket "
+                     "lowered as f32, the declared quantized residency "
+                     "never reached the compiled program")))
     by_dtype: Dict[str, int] = {}
     first: Dict[str, ir.Instruction] = {}
     for (dtype, _), insts in sorted(hits.items()):

@@ -367,6 +367,37 @@ def program_matrix(vocab: int = 4096, width: int = 16, tables: int = 4,
             storage_dtypes=tuple(sorted(
                 {b.storage_dtype for b in q_emb.plan.tp_buckets}))),
         skip_passes=("collective-overlap",)))
+
+    # 8: HBM-resident quantized serve forward (ISSUE 17) — the same
+    # int8 declaration with NO offload budget, so every bucket stays
+    # device-resident and quantizes under the lifted planner gate. The
+    # i8 payload tables and their f32 per-row scales enter the jitted
+    # program as params and decode at gather time, so the lowering must
+    # carry i8 buffers attributable to the declaration — and the
+    # declared-but-f32 direction of the storage-dtype pass proves the
+    # declaration actually reached the compiled program (a plan that
+    # says 'int8' over an all-f32 lowering now flags instead of
+    # silently shipping 4x the HBM).
+    h_model = build_model(vocab, width, "sum", tables=tables, mesh=mesh,
+                          storage_dtype="int8")
+    h_emb = h_model.embedding
+    assert h_emb.quantized_buckets and not any(
+        b.offload for b in h_emb.plan.tp_buckets), \
+        "quantized_hbm_serve: expected device-resident quantized buckets"
+    h_sp = {"embedding": h_emb.init(_jax.random.PRNGKey(0))}
+    h_text = _jax.jit(
+        lambda p, i: h_emb.apply(p["embedding"], list(i))).lower(
+        h_sp, cats).as_text()
+    h_wires, h_id_wires, h_groups = _plan_wires(h_emb)
+    programs.append(Program(
+        name="quantized_hbm_serve", text=h_text,
+        ctx=PlanContext(
+            program="quantized_hbm_serve", wire_dtypes=h_wires,
+            id_wire_dtypes=h_id_wires, sort_bound=h_groups,
+            donate_expected=False,
+            storage_dtypes=tuple(sorted(
+                {b.storage_dtype for b in h_emb.plan.tp_buckets}))),
+        skip_passes=("collective-overlap",)))
     return programs
 
 
@@ -461,6 +492,15 @@ module @m {
     %0 = stablehlo.convert %arg0 : (tensor<8x4xf32>) -> tensor<8x4xi8>
     %1 = stablehlo.convert %0 : (tensor<8x4xi8>) -> tensor<8x4xf32>
     return %1 : tensor<8x4xf32>
+  }
+}
+"""
+
+_MUT_F32_UNDER_INT8_DECL = """
+module @m {
+  func.func public @main(%arg0: tensor<8x4xf32>, %arg1: tensor<2xi32>) -> tensor<2x4xf32> {
+    %0 = "stablehlo.gather"(%arg0, %arg1) {dimension_numbers = #stablehlo.gather<offset_dims = [1], collapsed_slice_dims = [0], start_index_map = [0], index_vector_dim = 1>, slice_sizes = array<i64: 1, 4>} : (tensor<8x4xf32>, tensor<2xi32>) -> tensor<2x4xf32>
+    return %0 : tensor<2x4xf32>
   }
 }
 """
@@ -572,6 +612,16 @@ def mutation_cases() -> List[MutationCase]:
             ctx=PlanContext(program="mutation",
                             storage_dtypes=("f32",)),
             expect_fids=("storage-dtype/undeclared.i8",)),
+        MutationCase(
+            # ISSUE 17 (inverse direction): the plan declares int8
+            # storage but every buffer in the lowered program is f32 —
+            # an HBM-resident table whose quantization was silently
+            # dropped (the declared ~4x HBM saving never compiled in)
+            name="declared-int8-but-f32-buffers",
+            pass_name="storage-dtype", text=_MUT_F32_UNDER_INT8_DECL,
+            ctx=PlanContext(program="mutation",
+                            storage_dtypes=("f32", "int8")),
+            expect_fids=("storage-dtype/declared-but-f32.i8",)),
         MutationCase(
             name="self-duplicated-collective",
             pass_name="dead-dup-collective", text=_MUT_DUP_COLLECTIVE,

@@ -518,23 +518,33 @@ class DistributedEmbedding:
                     "but this backend exposes no host memory space: "
                     "offloaded buckets remain device-resident and count "
                     "against device memory.", RuntimeWarning, stacklevel=2)
-        # quantized at-rest storage (ISSUE 15) rides the offload lookup
-        # seam: with offload runtime-disabled the bucket's gathers run
-        # INSIDE the shard_map with no decode hook — demote to f32
-        # loudly rather than serve raw int8 rows as embeddings
+        # quantized at-rest storage for OFFLOADED buckets (ISSUE 15)
+        # rides the offload lookup seam: with offload runtime-disabled
+        # those gathers run INSIDE the shard_map through the plain f32
+        # path with no host decode hook — demote them to f32 loudly
+        # rather than serve raw int8 rows as embeddings. HBM-resident
+        # quantized buckets (ISSUE 17) decode inside the jitted forward
+        # and are untouched by the offload runtime gate.
         if not self._offload_enabled and any(
-                b.storage_dtype != "f32" for b in self.plan.tp_buckets):
+                b.offload and b.storage_dtype != "f32"
+                for b in self.plan.tp_buckets):
             import warnings
             warnings.warn(
-                "storage_dtype quantization demoted to f32: host offload "
-                "is disabled on this backend and quantized storage "
-                "decodes at the offloaded-gather seam.",
-                RuntimeWarning, stacklevel=2)
+                "storage_dtype quantization demoted to f32 for offloaded "
+                "bucket(s): host offload is disabled on this backend and "
+                "offloaded quantized storage decodes at the "
+                "offloaded-gather seam.", RuntimeWarning, stacklevel=2)
             for b in self.plan.tp_buckets:
-                b.storage_dtype = "f32"
+                if b.offload:
+                    b.storage_dtype = "f32"
         # jitted per-bucket storage codec fns (decode at gather /
         # SR re-encode at write-back), cached per bucket
         self._store_codec_cache: dict = {}
+        # touched-rows quantized host-apply accounting (ISSUE 17): raw
+        # totals mirrored into the default registry's
+        # store/quantized_rows_applied_total counter per apply
+        self.quantized_rows_applied_total: int = 0
+        self.quantized_apply_bytes_total: int = 0
 
     def _bucket_store_dtype(self, b: int) -> str:
         """The at-rest storage dtype of tp bucket b ('f32' | 'int8' |
@@ -563,6 +573,21 @@ class DistributedEmbedding:
                 "init/set_weights; a hand-stripped checkpoint cannot "
                 "decode)")
         return scale
+
+    def _device_bucket_scales(self, params: dict):
+        """Per-bucket stacked scale leaves for quantized DEVICE-resident
+        buckets (None elsewhere), or None when no bucket needs one — the
+        forward/update shard_map threading of ISSUE 17. Host-offloaded
+        scales stay OUT of shard_map bodies (XLA memory-space
+        propagation does not reach through them); those decode at the
+        offloaded-gather seam (`_host_group_exchange`) instead."""
+        if not self.quantized_buckets:
+            return None
+        out = [(self._bucket_scale(params, b)
+                if (self._bucket_store_dtype(b) != "f32"
+                    and self._bucket_memory_kind(b) is None) else None)
+               for b in range(len(self.plan.tp_buckets))]
+        return out if any(s is not None for s in out) else None
 
     def _encoded_shard_fn(self, shard_fn, encoder):
         """(rank, b, part) accessor over quantized bucket shards with
@@ -1426,7 +1451,7 @@ class DistributedEmbedding:
     def _forward_local(self, dp_params, tp_params, row_params,
                        dp_in, group_ids, group_w, row_in, groups,
                        taps=None, want_res=False, sort_plan=None,
-                       row_sort_plan=None, hot_params=None):
+                       row_sort_plan=None, hot_params=None, tp_scales=None):
         """The per-device forward (shard_map body when world > 1).
 
         Args:
@@ -1443,6 +1468,9 @@ class DistributedEmbedding:
           sort_plan / row_sort_plan: static per-group / per-row-input sort
             production plan (see `_sort_plan`) — which GroupSort residuals
             to build, and whether the tiled forward consumes them.
+          tp_scales: per-bucket stacked per-row scale shards (None at f32
+            or host-offloaded buckets) — quantized HBM-resident buckets
+            (ISSUE 17) decode at gather time via `_tp_group_out`.
 
         Returns (dp_outs, ex_list, row_outs, off_ids, off_w, res):
           dp_outs: [B_l, w] (or [B_l, K, w]) per dp input
@@ -1589,7 +1617,9 @@ class DistributedEmbedding:
                 out = self._tp_group_out(
                     tp_params, grp, ids_x, w_x,
                     None if taps is None else taps["tp"][g],
-                    presorted=sort_g)
+                    presorted=sort_g,
+                    scale_s=(None if tp_scales is None
+                             else tp_scales[grp.bucket]))
                 ex_list.append(self._tp_bucket_exchange(
                     out, bucket.wire_dtype))
             if want_res:
@@ -1898,15 +1928,34 @@ class DistributedEmbedding:
             contrib = contrib + hot_tap.astype(contrib.dtype)
         return contrib
 
-    def _tp_group_out(self, tp_params, grp, ids_x, w_x, tap, presorted=None):
+    def _tp_group_out(self, tp_params, grp, ids_x, w_x, tap, presorted=None,
+                      scale_s=None):
         """One exchange group's local bucket output [B, f, w_out], via the
         explicit weighted-sum form (so tapped and untapped paths share
-        numerics), plus the optional tap perturbation."""
+        numerics), plus the optional tap perturbation.
+
+        scale_s: the bucket's stacked per-row scale shard for quantized
+        HBM-RESIDENT storage (ISSUE 17) — the payload rows and their
+        scales gather together and decode right here, inside the jitted
+        program (the device twin of `_host_group_exchange`'s
+        decode-at-gather). The kernel lookup paths (pallas/tiled/fused)
+        are f32-table programs, so quantized buckets take the explicit
+        gather+combine form — the same numerics as `_group_lookup`'s XLA
+        route with one decode inserted before the cast."""
         bucket = self.plan.tp_buckets[grp.bucket]
         eff_w, scale = _effective_weights(w_x, grp.k, bucket.combiner)
-        out = self._group_lookup(
-            tp_params[grp.bucket][0], ids_x, eff_w,
-            None if bucket.combiner is None else "sum", presorted=presorted)
+        if scale_s is not None:
+            emb = jnp.take(tp_params[grp.bucket][0], ids_x, axis=0)
+            srow = jnp.take(scale_s[0], ids_x, axis=0)
+            emb = self._cast(wire_ops.decode_rows(
+                emb, srow, bucket.storage_dtype))
+            out = _combine(emb, eff_w,
+                           None if bucket.combiner is None else "sum")
+        else:
+            out = self._group_lookup(
+                tp_params[grp.bucket][0], ids_x, eff_w,
+                None if bucket.combiner is None else "sum",
+                presorted=presorted)
         if scale != 1.0:
             out = out * jnp.asarray(scale, out.dtype)
         if tap is not None:
@@ -2030,12 +2079,13 @@ class DistributedEmbedding:
     def _offload_group_out(self, g, grp, table, scale, off_id, off_w,
                            tap_g):
         """One offloaded group's output: the serving override when scoped
-        (and tapless, and the bucket stores f32 — the override contract
-        hands RAW table rows to the cache, which a quantized bucket
-        cannot honor without the decode seam), else the host-memory
-        gather+combine (decode-at-gather for quantized storage)."""
-        if (tap_g is None and scale is None
-                and self._offload_lookup_override is not None):
+        (and tapless), else the host-memory gather+combine
+        (decode-at-gather for quantized storage). The override receives
+        the AT-REST table leaf — raw f32 rows, or the quantized payload
+        whose decode (via the bucket's scale leaf) is the override's
+        job; the serving cache's decode seam (ISSUE 17) fetches that
+        scale itself from the same traced params."""
+        if tap_g is None and self._offload_lookup_override is not None:
             out = self._offload_lookup_override(g, grp, table, off_id, off_w)
             if out is not None:
                 return out
@@ -2246,11 +2296,12 @@ class DistributedEmbedding:
                 "tapped hot-split forward needs taps['hot'] — build the "
                 "tap pytree with make_taps() (it adds the hot entry when "
                 "hot_rows is active), or pass taps=None")
+        dev_scales = self._device_bucket_scales(params)
         if world > 1:
             specs = lambda tree, spec: jax.tree.map(lambda _: spec, tree)
             args = (params["dp"], params["tp"], params["row"],
                     dp_in, group_ids, group_w, row_in, inner_taps,
-                    hot_params)
+                    hot_params, dev_scales)
             # the hot-shard taps enter batch-sharded with the serving-rank
             # axis intact (P(None, axis)) — each device adds the hot
             # contribution for its OWN batch slice across all source ranks
@@ -2271,7 +2322,8 @@ class DistributedEmbedding:
                         specs(group_w, P(self.axis)),
                         specs(row_in, P(self.axis)),
                         tap_specs,
-                        specs(hot_params, P()))
+                        specs(hot_params, P()),
+                        specs(dev_scales, P(self.axis)))
             off_id_specs = [P(self.axis) if g in offloaded_groups else None
                             for g in range(len(groups))]
             off_w_specs = [
@@ -2303,10 +2355,12 @@ class DistributedEmbedding:
                 [P(self.axis) if g in hot_groups else None
                  for g in range(len(groups))]) if want_res else None,)
             dp_outs, ex_list, row_outs, off_ids, off_w, res = compat.shard_map(
-                lambda d, t, r, di, gi, gw, ri, tp, hp: self._forward_local(
+                lambda d, t, r, di, gi, gw, ri, tp, hp, sc:
+                self._forward_local(
                     d, t, r, di, gi, gw, ri, groups, taps=tp,
                     want_res=want_res, sort_plan=sort_plan,
-                    row_sort_plan=row_sort_plan, hot_params=hp),
+                    row_sort_plan=row_sort_plan, hot_params=hp,
+                    tp_scales=sc),
                 mesh=self.mesh, in_specs=in_specs,
                 out_specs=out_specs + res_specs,
                 check_vma=False,
@@ -2318,7 +2372,7 @@ class DistributedEmbedding:
                     dp_in, group_ids, group_w, row_in, groups,
                     taps=inner_taps, want_res=want_res,
                     sort_plan=sort_plan, row_sort_plan=row_sort_plan,
-                    hot_params=hot_params))
+                    hot_params=hot_params, tp_scales=dev_scales))
 
         if _want_exchange:
             # lookahead prefetch return (ISSUE 9): the raw exchange-stage
@@ -2843,7 +2897,7 @@ class DistributedEmbedding:
         sort_plan = (self._sort_plan(groups, sort_spec) if return_residuals
                      else [None] * len(groups))
 
-        def body(tp_params, group_ids, group_w, taps_l):
+        def body(tp_params, group_ids, group_w, taps_l, tp_scales):
             ex_list, off_ids, off_w = [], [], []
             res_ids, res_w, res_sort = [], [], []
             for g, grp in enumerate(groups):
@@ -2868,7 +2922,9 @@ class DistributedEmbedding:
                     out = self._tp_group_out(
                         tp_params, grp, ids_l, w_l,
                         None if taps_l is None else taps_l["tp"][g],
-                        presorted=sort_g)
+                        presorted=sort_g,
+                        scale_s=(None if tp_scales is None
+                                 else tp_scales[grp.bucket]))
                     ex_list.append(self._tp_bucket_exchange(
                         out, bucket.wire_dtype))
                 if return_residuals:
@@ -2879,6 +2935,8 @@ class DistributedEmbedding:
             res = ((res_ids, res_w, res_sort) if return_residuals
                    else None)
             return ex_list, off_ids, off_w, res
+
+        dev_scales = self._device_bucket_scales(params)
 
         if world > 1:
             specs = lambda tree, spec: jax.tree.map(lambda _: spec, tree)
@@ -2900,13 +2958,15 @@ class DistributedEmbedding:
                 in_specs=(specs(params["tp"], P(self.axis)),
                           specs(group_ids, P(self.axis)),
                           specs(group_w, P(self.axis)),
-                          specs(inner_taps, P(self.axis))),
+                          specs(inner_taps, P(self.axis)),
+                          specs(dev_scales, P(self.axis))),
                 out_specs=out_specs,
                 check_vma=False,
-            )(params["tp"], group_ids, group_w, inner_taps)
+            )(params["tp"], group_ids, group_w, inner_taps, dev_scales)
         else:
             ex_list, off_ids, off_w, res = body(params["tp"], group_ids,
-                                                group_w, inner_taps)
+                                                group_w, inner_taps,
+                                                dev_scales)
 
         for g in offloaded_groups:
             grp = groups[g]
@@ -3054,7 +3114,7 @@ class DistributedEmbedding:
                             row_states, tp_g, row_g, res_tp_ids, res_tp_w,
                             res_row_ids, res_row_w, res_tp_sort,
                             res_row_sort, hot_tabs, hot_states, hot_g,
-                            res_hot_pos, res_hot_w, groups, opt,
+                            res_hot_pos, res_hot_w, tp_scales, groups, opt,
                             dev_buckets):
         """Per-device sparse updates (stacked [1, rows, w] shards in/out).
         tp_params/tp_states hold only the non-offloaded buckets, in
@@ -3084,7 +3144,9 @@ class DistributedEmbedding:
             bucket_groups.setdefault(grp.bucket, []).append(g)
 
         new_tp, new_tp_s = [], []
+        new_tp_sc = []
         for pos, b in enumerate(dev_buckets):
+            scale_s = None if tp_scales is None else tp_scales[pos]
             gs = bucket_groups.get(b, [])
             grads = [self._group_contrib(g, groups[g], res_tp_ids, res_tp_w,
                                          tp_g, stacked=False)
@@ -3092,6 +3154,7 @@ class DistributedEmbedding:
             if not grads:
                 new_tp.append(tp_params[pos])
                 new_tp_s.append(tp_states[pos])
+                new_tp_sc.append(scale_s)
                 continue
             sort_b = (self._unstack_sort(res_tp_sort[gs[0]])
                       if len(gs) == 1 else None)
@@ -3099,11 +3162,28 @@ class DistributedEmbedding:
             # SparseOptimizers with 3-arg update callables keep working
             # whenever no fold is active
             kw = {} if sort_b is None else {"presorted": sort_b}
+            if scale_s is not None:
+                # master-weight-free quantized row update (ISSUE 17):
+                # decode touched rows -> f32 math -> hash-SR re-encode,
+                # no resident f32 mirror of the table
+                hp = dict(opt.hp)
+                if opt.kind == "adagrad" and "eps" in hp:
+                    kw["eps"] = hp["eps"]
+                p_new, s_new_sc, st_new = \
+                    sparse_update_ops.quantized_row_update(
+                        opt.kind, tp_params[pos][0], scale_s[0],
+                        split_state(tp_states[pos]), concat_grads(grads),
+                        self._bucket_store_dtype(b), opt.lr, **kw)
+                new_tp.append(p_new[None])
+                new_tp_sc.append(s_new_sc[None])
+                new_tp_s.append(stack_state(st_new))
+                continue
             t_new, s_new = opt.update(tp_params[pos][0],
                                       split_state(tp_states[pos]),
                                       concat_grads(grads), **kw)
             new_tp.append(t_new[None])
             new_tp_s.append(stack_state(s_new))
+            new_tp_sc.append(None)
 
         # row-sliced tables: multiple inputs may share one table
         table_inputs: dict = {}
@@ -3165,7 +3245,8 @@ class DistributedEmbedding:
                 counts > 0, opt.lr, **hot_kw)
             new_hot_t.append(t_new)
             new_hot_s.append(tuple(s_new))
-        return new_tp, new_row, new_tp_s, new_row_s, new_hot_t, new_hot_s
+        return (new_tp, new_row, new_tp_s, new_row_s, new_hot_t, new_hot_s,
+                new_tp_sc if tp_scales is not None else None)
 
     def init_sparse_state(self, params: dict, opt: SparseOptimizer) -> dict:
         """Sparse-optimizer state for the tp/row tables (dp tables train
@@ -3252,7 +3333,24 @@ class DistributedEmbedding:
                 f"sparse optimizer {opt.kind!r} has no host-memory apply "
                 "rule for offloaded buckets (available: "
                 f"{sorted(sparse_update_ops.HOST_SPARSE_APPLY)})")
+        q_dev = [b for b in dev_buckets
+                 if self._bucket_store_dtype(b) != "f32"]
+        if q_dev and opt.kind not in sparse_update_ops.QUANTIZED_ROW_KINDS:
+            raise NotImplementedError(
+                f"sparse optimizer {opt.kind!r} has no master-weight-free "
+                f"quantized row-update rule (HBM-quantized buckets "
+                f"{q_dev}; available: "
+                f"{sorted(sparse_update_ops.QUANTIZED_ROW_KINDS)}). adam's "
+                "moment-normalized steps fall below the per-row "
+                "quantization grid during bias correction and are "
+                "systematically lost even under stochastic rounding; its "
+                "f32 moments also dwarf the table saving. Keep such "
+                "buckets at storage_dtype='f32', or offload them "
+                "(host apply keeps f32 math end-to-end).")
         groups, _ = self._exchange_groups_for_key(residuals.key)
+        tp_dev_sc = ([self._bucket_scale(params, b)
+                      if self._bucket_store_dtype(b) != "f32" else None
+                      for b in dev_buckets] if q_dev else None)
         tp_dev = [params["tp"][b] for b in dev_buckets]
         tp_dev_s = [opt_states["tp"][b] for b in dev_buckets]
         # sort-folding artifacts (absent on pre-fold / residual_sort-off
@@ -3277,7 +3375,8 @@ class DistributedEmbedding:
                 opt_states["row"], tap_grads["tp"], tap_grads["row"],
                 residuals.tp_ids, residuals.tp_w, residuals.row_ids,
                 residuals.row_w, tp_sort, row_sort,
-                hot_tabs, hot_states, hot_g, res_hot_pos, res_hot_w)
+                hot_tabs, hot_states, hot_g, res_hot_pos, res_hot_w,
+                tp_dev_sc)
         if self.world_size > 1:
             sspec = lambda tree: jax.tree.map(self._state_spec, tree)
             pspec = lambda tree, s: jax.tree.map(lambda _: s, tree)
@@ -3297,20 +3396,22 @@ class DistributedEmbedding:
                         [None if g is None else P(None, self.axis)
                          for g in hot_g],
                         pspec(res_hot_pos, P(self.axis)),
-                        pspec(res_hot_w, P(self.axis)))
+                        pspec(res_hot_w, P(self.axis)),
+                        pspec(tp_dev_sc, P(self.axis)))
             out_specs = (pspec(tp_dev, P(self.axis)),
                          pspec(params["row"], P(self.axis)),
                          sspec(tp_dev_s), sspec(opt_states["row"]),
-                         pspec(hot_tabs, P()), sspec(hot_states))
+                         pspec(hot_tabs, P()), sspec(hot_states),
+                         pspec(tp_dev_sc, P(self.axis)))
             (new_tp_dev, new_row, new_tp_dev_s, new_row_s, new_hot_t,
-             new_hot_s) = compat.shard_map(
+             new_hot_s, new_tp_sc) = compat.shard_map(
                 lambda *a: self._sparse_update_body(*a, groups, opt,
                                                     dev_buckets),
                 mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False)(*args)
         else:
             (new_tp_dev, new_row, new_tp_dev_s, new_row_s, new_hot_t,
-             new_hot_s) = (
+             new_hot_s, new_tp_sc) = (
                 self._sparse_update_body(*args, groups, opt, dev_buckets))
 
         new_tp = list(params["tp"])
@@ -3328,9 +3429,16 @@ class DistributedEmbedding:
                    for b in off_buckets}
         new_params = {"dp": params["dp"], "tp": new_tp, "row": new_row}
         if "tp_scale" in params:
-            # quantized-storage scales (ISSUE 15) are read-only inside
-            # the jitted step; the out-of-jit host apply refreshes them
-            new_params["tp_scale"] = params["tp_scale"]
+            # offloaded-bucket scales are read-only inside the jitted
+            # step (the out-of-jit host apply refreshes them);
+            # HBM-resident quantized buckets re-derive theirs in the
+            # master-weight-free row update above (ISSUE 17)
+            new_scales = list(params["tp_scale"])
+            if new_tp_sc is not None:
+                for pos, b in enumerate(dev_buckets):
+                    if new_tp_sc[pos] is not None:
+                        new_scales[b] = new_tp_sc[pos]
+            new_params["tp_scale"] = new_scales
         new_states = {"tp": new_tp_s, "row": new_row_s}
         if "hot" in params:
             new_hot = list(params["hot"])
@@ -3365,20 +3473,18 @@ class DistributedEmbedding:
                           scale_h=None):
         """Storage-dtype dispatch over `_host_bucket_apply_f32` (ISSUE
         15). f32 buckets pass straight through (bit-exact, the
-        early-return contract). Quantized buckets round-trip through
-        f32: decode (payload, scale) -> run the stock f32 apply (same
-        modes, same optimizer math — state stays f32 master-free of the
-        TABLE only) -> re-encode with the wire seam's keyless hash-SR,
-        so the write-back rounding error centers on zero across a
-        step's many updated values instead of accumulating RNE bias.
-        Returns (table, state) at f32 and (payload, scale, state) when
-        `scale_h` is given. The decode/encode pair is whole-bucket AND
-        transits default device memory (plain jits — the host-compute
-        codec shares the `native` mode's backend gaps), so a quantized
-        bucket's apply costs a roundtrip-class transfer per step and
-        needs the decoded f32 bucket to FIT on device: the honest v1.
-        The touched-rows-only host-kernel epilogue that removes both
-        costs is ROADMAP item 2's remaining work."""
+        early-return contract). Quantized buckets update
+        TOUCHED-ROWS-ONLY (ISSUE 17): per local shard, decode exactly
+        the rows the pending delta names into a compact f32 block, run
+        the stock host row kernels on it, and hash-SR re-encode those
+        rows back in place — O(touched rows) bytes moved per apply, vs
+        the v1 whole-bucket f32 round-trip (kept behind
+        DET_HOST_APPLY=roundtrip for hardware A/B; it transits device
+        memory and needs the decoded f32 bucket to fit there).
+        Keyless hash-SR on the write-back centers the rounding error
+        on zero across a step's many updated values instead of
+        accumulating RNE bias. Returns (table, state) at f32 and
+        (payload, scale, state) when `scale_h` is given."""
         sd = self._bucket_store_dtype(b)
         if sd == "f32":
             if scale_h is not None:
@@ -3392,22 +3498,144 @@ class DistributedEmbedding:
             raise ValueError(
                 f"bucket {b} stores {sd} rows: host_bucket_apply needs "
                 "the params['tp_scale'] leaf alongside the payload")
-        ckey = ("store_codec", b, sd)
-        codec = self._store_codec_cache.get(ckey)
-        if codec is None:
-            codec = (jax.jit(functools.partial(wire_ops.decode_rows,
-                                               store_dtype=sd)),
-                     jax.jit(functools.partial(wire_ops.encode_rows,
-                                               store_dtype=sd, sr=True)))
-            self._store_codec_cache[ckey] = codec
-        decode, encode_sr = codec
-        back = table_h.sharding
-        table_f = jax.device_put(decode(table_h, scale_h), back)
-        new_f, new_state = self._host_bucket_apply_f32(
-            b, table_f, state_h, rep, sums, valid, opt, lr_value=lr_value)
-        payload, scale = encode_sr(new_f)
-        return (jax.device_put(payload, back), jax.device_put(scale, back),
-                new_state)
+        if os.environ.get("DET_HOST_APPLY") == "roundtrip":
+            ckey = ("store_codec", b, sd)
+            codec = self._store_codec_cache.get(ckey)
+            if codec is None:
+                codec = (jax.jit(functools.partial(wire_ops.decode_rows,
+                                                   store_dtype=sd)),
+                         jax.jit(functools.partial(wire_ops.encode_rows,
+                                                   store_dtype=sd,
+                                                   sr=True)))
+                self._store_codec_cache[ckey] = codec
+            decode, encode_sr = codec
+            back = table_h.sharding
+            self._host_fn_cache[("host_apply_mode", b, opt.kind)] = \
+                "roundtrip"
+            table_f = jax.device_put(decode(table_h, scale_h), back)
+            new_f, new_state = self._host_bucket_apply_f32(
+                b, table_f, state_h, rep, sums, valid, opt,
+                lr_value=lr_value)
+            payload, scale = encode_sr(new_f)
+            return (jax.device_put(payload, back),
+                    jax.device_put(scale, back), new_state)
+        return self._host_quantized_touched_apply(
+            b, sd, table_h, scale_h, state_h, rep, sums, valid, opt,
+            lr_value=lr_value)
+
+    def _host_quantized_touched_apply(self, b, sd, table_h, scale_h,
+                                      state_h, rep, sums, valid,
+                                      opt: SparseOptimizer, lr_value=None):
+        """Touched-rows-only quantized host apply (ISSUE 17): the
+        `_host_pershard_apply` walk specialized to (payload, scale)
+        buckets. Per local shard and world slice, fetch the deduped
+        update rows off device (the native wire volume), decode ONLY
+        those rows to a compact f32 block, apply them with the
+        C++/numpy row kernels against the f32 optimizer state, then
+        hash-SR re-encode the block back into the payload/scale
+        buffers in place. Bytes moved per apply are
+        O(touched rows x delta_row_bytes), independent of bucket
+        size; `store/quantized_rows_applied_total` (default registry)
+        and the layer's raw totals record the volume."""
+        apply_fn = sparse_update_ops.HOST_SPARSE_APPLY[opt.kind]
+        hp = dict(opt.hp)
+        kw = {k: hp[k] for k in ("eps", "b1", "b2")
+              if k in hp and opt.kind in ("adagrad", "adam")}
+        lr = float(jax.device_get(opt.lr if lr_value is None
+                                  else lr_value))
+        self._host_fn_cache[("host_apply_mode", b, opt.kind)] = "pershard"
+
+        def by_device(x):
+            return {s.device: s.data for s in x.addressable_shards}
+
+        p_shards = list(table_h.addressable_shards)
+        sc_d = by_device(scale_h)
+        rep_d, sums_d, valid_d = by_device(rep), by_device(sums), \
+            by_device(valid)
+        arr_state = [x for x in state_h if getattr(x, "ndim", 0) >= 1]
+        state_d = [by_device(x) for x in arr_state]
+        scalar_after = {
+            i: jax.device_get(x) + (1 if opt.kind == "adam" else 0)
+            for i, x in enumerate(state_h)
+            if getattr(x, "ndim", 0) == 0}
+
+        rows_applied = 0
+        new_p, new_sc, new_s = [], [], [[] for _ in arr_state]
+        for sh in p_shards:
+            dev = sh.device
+            p_np = np.array(sh.data)            # host->host copy, mutable
+            sc_np = np.array(sc_d[dev])
+            s_nps = [np.array(sd_[dev]) for sd_ in state_d]
+            rep_np = np.asarray(rep_d[dev])     # rows only cross the wire
+            sums_np = np.asarray(sums_d[dev])
+            valid_np = np.asarray(valid_d[dev])
+            nw = p_np.shape[0]
+            drift = [(name, a.shape) for name, a in
+                     (("scale", sc_np), ("rep", rep_np), ("sums", sums_np),
+                      ("valid", valid_np),
+                      *((f"state[{i}]", s) for i, s in enumerate(s_nps)))
+                     if a.shape[0] != nw]
+            if drift:
+                raise RuntimeError(
+                    f"quantized per-shard apply: device {dev} holds "
+                    f"{nw} world slice(s) of the payload but the update "
+                    f"arrays have mismatched leading dims {drift} — "
+                    "sharding layout drifted between the step jit's "
+                    "pending outputs and the pinned-host bucket")
+            for j in range(nw):                 # world slices on this shard
+                ok = valid_np[j] > 0
+                ru = rep_np[j][ok]
+                m = int(ru.shape[0])
+                if m == 0:
+                    continue
+                # compact f32 block of exactly the touched rows
+                sub = np.ascontiguousarray(wire_ops.decode_rows_np(
+                    p_np[j][ru], sc_np[j][ru], sd))
+                st_subs = [np.ascontiguousarray(s[j][ru]) for s in s_nps]
+                if opt.kind == "adam":
+                    st = (st_subs[0], st_subs[1],
+                          next(iter(scalar_after.values())))
+                else:
+                    st = tuple(st_subs)
+                sparse_update_ops.host_apply_rows_inplace(
+                    opt.kind, sub, st,
+                    np.arange(m, dtype=rep_np.dtype),
+                    np.ascontiguousarray(sums_np[j][ok]),
+                    np.ones(m, dtype=valid_np.dtype), lr, **kw)
+                for s, st_sub in zip(s_nps, st_subs):
+                    s[j][ru] = st_sub           # fancy-index wrote a copy
+                pay, scl = wire_ops.encode_rows_np(sub, sd, sr=True)
+                p_np[j][ru] = pay
+                sc_np[j][ru] = scl
+                rows_applied += m
+            new_p.append(jax.device_put(p_np, sh.data.sharding))
+            new_sc.append(jax.device_put(sc_np, sc_d[dev].sharding))
+            for i, s_np in enumerate(s_nps):
+                new_s[i].append(
+                    jax.device_put(s_np, state_d[i][dev].sharding))
+
+        self.quantized_rows_applied_total += rows_applied
+        self.quantized_apply_bytes_total += rows_applied * \
+            wire_ops.delta_row_bytes(table_h.shape[-1], sd)
+        from distributed_embeddings_tpu.obs.registry import default_registry
+        default_registry().counter(
+            "store/quantized_rows_applied_total").inc(rows_applied)
+
+        def assemble(global_ref, shards):
+            return jax.make_array_from_single_device_arrays(
+                global_ref.shape, global_ref.sharding, shards)
+
+        out_state, ai = [], 0
+        for i, x in enumerate(state_h):
+            if getattr(x, "ndim", 0) >= 1:
+                out_state.append(assemble(x, new_s[ai]))
+                ai += 1
+            else:
+                out_state.append(jax.device_put(
+                    jnp.asarray(scalar_after[i], dtype=x.dtype),
+                    x.sharding))
+        return (assemble(table_h, new_p), assemble(scale_h, new_sc),
+                tuple(out_state))
 
     def _host_bucket_apply_f32(self, b, table_h, state_h, rep, sums, valid,
                                opt: SparseOptimizer, lr_value=None):

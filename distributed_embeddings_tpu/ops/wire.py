@@ -306,10 +306,13 @@ def decode_rows(payload: jax.Array, scale, store_dtype: str) -> jax.Array:
     return payload.astype(jnp.float32) * scale
 
 
-def encode_rows_np(rows, store_dtype: str):
-    """Host-side (numpy) twin of `encode_rows` for stream/stash
-    payloads — always RNE (published bytes must be deterministic and
-    reproducible; SR is the training write-back's tool)."""
+def encode_rows_np(rows, store_dtype: str, sr: bool = False,
+                   salt: int = 0x85EBCA6B):
+    """Host-side (numpy) twin of `encode_rows`. Default RNE (published
+    stream/stash bytes must be deterministic and reproducible);
+    ``sr=True`` is the touched-rows host APPLY's write-back (ISSUE 17) —
+    the identical keyless (lane, value-bits, salt) hash as the device
+    encoder, int8 only (fp8's own RNE cast, as on device)."""
     import numpy as np
     store_dtype = resolve_store_dtype(store_dtype)
     rows = np.asarray(rows, np.float32)
@@ -320,7 +323,20 @@ def encode_rows_np(rows, store_dtype: str):
     if store_dtype == "int8":
         scale = np.where(amax > 0, amax / INT8_AMAX, 1.0).astype(np.float32)
         with np.errstate(invalid="ignore"):
-            q = np.rint(rows / scale)
+            y = (rows / scale).astype(np.float32)
+            if sr and y.size:
+                bits = y.view(np.uint32)
+                idx = np.arange(y.size, dtype=np.uint32).reshape(y.shape)
+                with np.errstate(over="ignore"):
+                    h = bits ^ (idx * np.uint32(2654435761)
+                                + np.uint32(salt))
+                    h = (h ^ (h >> np.uint32(15))) * np.uint32(0x2C1B3C6D)
+                    h = (h ^ (h >> np.uint32(12))) * np.uint32(0x297A2D39)
+                    h = h ^ (h >> np.uint32(15))
+                u = (h & np.uint32(0xFFFF)).astype(np.float32) / 65536.0
+                q = np.floor(y + u)
+            else:
+                q = np.rint(y)
         payload = np.clip(q, -INT8_AMAX, INT8_AMAX).astype(np.int8)
         return payload, scale
     import ml_dtypes

@@ -80,12 +80,12 @@ class TPBucket:
     # bit for bit.
     wire_dtype: str = "f32"
     id_wire_dtype: str = "int32"
-    # at-rest row storage dtype (ISSUE 15): 'f32' (default — arrays are
-    # byte-identical to pre-seam params), 'int8'/'fp8' (quantized
+    # at-rest row storage dtype (ISSUE 15/17): 'f32' (default — arrays
+    # are byte-identical to pre-seam params), 'int8'/'fp8' (quantized
     # payload + per-row f32 scale, decoded at gather time). Set by
     # lower_strategy from the planner's storage_dtype request, gated
-    # per bucket (see _storage_eligibility): only cold/offloaded
-    # buckets quantize — the HBM hot path keeps exact rows.
+    # per bucket (see _storage_eligibility): both offloaded and
+    # HBM-resident buckets quantize; hot-sharded buckets stay f32.
     storage_dtype: str = "f32"
     # dynamic-vocabulary slack (ISSUE 7): pre-reserved growth rows
     # folded into this bucket's rows_max (max over ranks of the summed
@@ -186,19 +186,28 @@ def _wire_eligibility(combiner: Optional[str], offload: bool,
     return requested
 
 
-def _storage_eligibility(offload: bool, requested: str) -> str:
+def _storage_eligibility(offload: bool, requested: str,
+                         hot_rows: int = 0) -> str:
     """At-rest storage dtype for one bucket, 'f32' when ineligible.
 
-    Only COLD (host-offloaded) buckets quantize: they are the capacity
-    bottleneck the codec exists for (~4x more rows per host byte, ~4x
-    fewer bytes per host<->device row move), their lookups already run
-    through one seam (`_host_group_exchange`) where the decode folds
-    into the gather, and their sparse apply runs out-of-jit where the
-    SR re-encode is a host-side epilogue. Device-resident buckets stay
-    f32: the HBM training hot path reads rows every step, and rounding
-    EVERY gather/update there is a different (master-weight) design —
-    ROADMAP item 2's stretch goal, not this seam."""
-    if not offload:
+    Both residencies quantize now (ISSUE 17): COLD (host-offloaded)
+    buckets were the PR 15 capacity bottleneck (~4x more rows per host
+    byte, decode folded into `_host_group_exchange`, SR re-encode a
+    host-side apply epilogue); HBM-RESIDENT buckets gain the same seam
+    — decode at gather time inside the jitted forward, and a
+    master-weight-free sparse update (decode touched rows -> f32 math
+    -> hash-SR re-encode) for the row-wise optimizers, so a quantized
+    table costs ~1/4 the HBM with no resident f32 mirror.
+
+    The one residual gate: a bucket with a HOT SHARD stays f32. The
+    hot shard replicates raw f32 rows and its write-back/admission
+    moves rows between the canonical table and the shard verbatim —
+    re-encoding on every membership change would quantize hot rows
+    repeatedly (unbounded drift), exactly the rows touched most.
+    Capacity-wise the hot shard already holds the bucket's densest
+    rows in f32, so quantizing the cold remainder under it is a
+    different design, not a smaller diff."""
+    if hot_rows > 0:
         return "f32"
     return requested
 
@@ -307,7 +316,8 @@ def lower_strategy(strategy: DistEmbeddingStrategy) -> ShardedPlan:
             bucket.combiner, bucket.offload, requested_wire)
         bucket.id_wire_dtype = _id_wire_dtype(bucket.rows_max, id_wire_mode)
         bucket.storage_dtype = _storage_eligibility(bucket.offload,
-                                                    requested_store)
+                                                    requested_store,
+                                                    bucket.hot_rows)
 
     # ---------------- row-sliced tables -------------------------------------
     row_tables: List[RowTablePlan] = []

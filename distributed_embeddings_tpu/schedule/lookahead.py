@@ -187,6 +187,14 @@ class LookaheadEngine:
                 "lookahead>0 does not support custom embedding layer "
                 "classes on dp tables (staged forwards run them outside "
                 "shard_map)")
+        if getattr(emb, "quantized_buckets", []):
+            raise NotImplementedError(
+                "lookahead>0 does not support quantized (int8/fp8) "
+                "bucket storage: the drain applies f32 row rules and "
+                "the touched-row patch carries f32 activations — "
+                "neither decodes or re-encodes the per-row "
+                "payload+scale leaves an HBM-resident quantized bucket "
+                "trains through")
         if (not emb.strategy.input_groups[1]
                 and not emb.strategy.input_groups[2]):
             raise ValueError(

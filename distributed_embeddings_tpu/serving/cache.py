@@ -49,6 +49,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_embeddings_tpu.ops import sparse_update as sparse_update_ops
+from distributed_embeddings_tpu.ops import wire as wire_ops
 from distributed_embeddings_tpu.ops.embedding_ops import (
     masked_two_source_gather, miss_only_ids)
 from distributed_embeddings_tpu.utils.hotness import HotnessTracker
@@ -83,23 +84,19 @@ class HotRowCache:
             raise ValueError(
                 f"bucket {bucket} is not host-offloaded; a hot-row cache "
                 "only makes sense over a host-resident table")
-        if bk.storage_dtype != "f32":
-            # quantized at-rest storage (ISSUE 15): every cache read path
-            # that touches the raw table (`admit`/`refresh`/the miss-lane
-            # gather in `cached_group_lookup`) assumes f32 rows; serving
-            # a quantized bucket falls back to the stock decode-at-gather
-            # host lookup until the cache grows the decode seam
-            raise ValueError(
-                f"bucket {bucket} stores {bk.storage_dtype} rows: the HBM "
-                "hot-row cache reads raw f32 table rows and does not yet "
-                "decode quantized storage — serve this bucket through the "
-                "stock offloaded lookup (it decodes at gather time)")
         self.emb = emb
         self.bucket = bucket
         self.capacity = int(capacity)
         self.promote_threshold = int(promote_threshold)
         self.width = bk.width
         self.rows_max = max(bk.rows_max, 1)
+        # quantized at-rest storage (ISSUE 17): the cache is the DECODE
+        # seam — slots always hold decoded f32 rows; quantized buckets
+        # decode at read time (`_read_rows`), so every resident row is
+        # served at full HBM bandwidth with no per-request codec work,
+        # and a quantized bucket's ~4x row density carries over to
+        # cache capacity per HBM byte
+        self.store_dtype = bk.storage_dtype
 
         # host-side index / counters / admission policy: the shared
         # tracker (utils/hotness.py) — long-lived servers see unbounded
@@ -176,10 +173,14 @@ class HotRowCache:
         jitted forward next to the slot indices)."""
         return self._slots
 
-    def _read_rows(self, table: jax.Array, keys: np.ndarray) -> np.ndarray:
+    def _read_rows(self, table: jax.Array, keys: np.ndarray,
+                   scale: Optional[jax.Array] = None) -> np.ndarray:
         """Fetch table rows for `keys` ([M] int64) host-side, via a cached
         jitted gather in the table's host memory space (rows-only traffic —
-        the bucket itself never moves)."""
+        the bucket itself never moves). Quantized buckets pass the per-row
+        `scale` leaf and get DECODED f32 rows back (the cache's decode
+        seam): payload + scale rows gather together, the codec runs on
+        the fetched rows only."""
         world = self.emb.world_size
         m_pad = _ceil_pow2(max(len(keys), 1))
         ids = np.zeros((world, m_pad), np.int32)
@@ -187,7 +188,7 @@ class HotRowCache:
         rows = (keys % self.rows_max).astype(np.int32)
         pos = np.arange(len(keys))
         ids[w_idx, pos] = rows
-        fn = self._reader_cache.get(m_pad)
+        fn = self._reader_cache.get((m_pad, scale is not None))
         if fn is None:
             emb = self.emb
             if emb.mesh is not None:
@@ -197,15 +198,27 @@ class HotRowCache:
                 host_sh = jax.sharding.SingleDeviceSharding(
                     jax.devices()[0], memory_kind=emb._host_kind)
 
-            def run(table_h, ids):
+            def run(table_h, ids, *scale_h):
                 ids_h = jax.device_put(ids, host_sh)
                 from jax.experimental import compute_on
                 with compute_on.compute_on("device_host"):
-                    return jax.vmap(sparse_update_ops.take_rows)(
+                    out = jax.vmap(sparse_update_ops.take_rows)(
                         table_h, ids_h)
+                    if scale_h:
+                        sc = jax.vmap(sparse_update_ops.take_rows)(
+                            scale_h[0], ids_h)
+                        return out, sc
+                    return out
 
             fn = jax.jit(run)
-            self._reader_cache[m_pad] = fn
+            self._reader_cache[(m_pad, scale is not None)] = fn
+        if scale is not None:
+            pay, sc = fn(table, ids, scale)
+            pay = np.asarray(jax.device_get(pay))          # [world, Mp, w]
+            sc = np.asarray(jax.device_get(sc))            # [world, Mp, 1]
+            return wire_ops.decode_rows_np(pay[w_idx, pos],
+                                           sc[w_idx, pos],
+                                           self.store_dtype)
         out = np.asarray(jax.device_get(fn(table, ids)))   # [world, Mp, w]
         return out[w_idx, pos]
 
@@ -226,18 +239,21 @@ class HotRowCache:
         """
         return self._tracker.lookup_slots(keys, valid=valid, observe=observe)
 
-    def admit(self, table: jax.Array) -> int:
+    def admit(self, table: jax.Array,
+              scale: Optional[jax.Array] = None) -> int:
         """Run the admission policy against the current counters, copying
-        newly-promoted rows out of `table`. Returns rows promoted."""
+        newly-promoted rows out of `table` (decoded through `scale` for
+        quantized buckets). Returns rows promoted."""
         plan = self._tracker.plan_admissions()
         if not plan:
             return 0
         keys = np.asarray([k for _, k in plan], np.int64)
-        rows = self._read_rows(table, keys)
+        rows = self._read_rows(table, keys, scale=scale)
         self._update_slots(np.asarray([s for s, _ in plan]), rows)
         return self._tracker.commit_admissions(plan)
 
-    def refresh(self, table: jax.Array) -> int:
+    def refresh(self, table: jax.Array,
+                scale: Optional[jax.Array] = None) -> int:
         """Re-copy every resident row from `table` into the HBM slots —
         REQUIRED after anything mutates the offloaded table (see the
         consistency contract in docs/serving.md). Returns rows refreshed.
@@ -247,7 +263,8 @@ class HotRowCache:
         which is exactly the two-path staleness seam the store closes."""
         resident = np.flatnonzero(self._slot_keys >= 0)
         if len(resident):
-            rows = self._read_rows(table, self._slot_keys[resident])
+            rows = self._read_rows(table, self._slot_keys[resident],
+                                   scale=scale)
             self._update_slots(resident, rows)
         self.refreshes += 1
         return int(len(resident))
@@ -297,7 +314,8 @@ class HotRowCache:
                 "refreshes": self.refreshes}
 
 
-def cached_group_lookup(emb, grp, table_h, slots, ids_g, slot_g, w_g):
+def cached_group_lookup(emb, grp, table_h, slots, ids_g, slot_g, w_g,
+                        scale_h=None):
     """One offloaded exchange group's output through the hot-row cache.
 
     The numerics mirror ``DistributedEmbedding._host_group_exchange``
@@ -306,6 +324,13 @@ def cached_group_lookup(emb, grp, table_h, slots, ids_g, slot_g, w_g):
     the only difference is the row source: hit lanes gather from the HBM
     `slots` tensor, miss lanes from the host table with hit ids clamped to
     row 0 (`miss_only_ids`) so hits generate no host-memory table traffic.
+
+    Quantized buckets (ISSUE 17) pass `scale_h` (the per-row scale
+    leaf): miss lanes decode inside the SAME host region their payload
+    rows gather in — identical codec expression to the stock offloaded
+    lookup's decode-at-gather, so the bit-match contract holds there
+    too. Hit lanes read already-decoded f32 slots and never touch the
+    codec.
 
     Transfer trade-off (deliberate): the stock host path combines on host
     and streams `[world, B, f, wf]` COMBINED rows device-ward; here the
@@ -331,6 +356,12 @@ def cached_group_lookup(emb, grp, table_h, slots, ids_g, slot_g, w_g):
     from jax.experimental import compute_on
 
     bucket = emb.plan.tp_buckets[grp.bucket]
+    if scale_h is None and bucket.storage_dtype != "f32":
+        raise ValueError(
+            f"bucket {grp.bucket} stores {bucket.storage_dtype} rows: "
+            "cached_group_lookup needs the params['tp_scale'] leaf as "
+            "scale_h — gathering raw payload codes would serve them as "
+            "embedding values")
     world = emb.world_size
     k, wf = grp.k, bucket.width
     rows_max = max(bucket.rows_max, 1)
@@ -359,6 +390,11 @@ def cached_group_lookup(emb, grp, table_h, slots, ids_g, slot_g, w_g):
     ids_h = jax.device_put(miss_only_ids(ids, slot_g), host_sh)
     with compute_on.compute_on("device_host"):
         miss_rows_h = jax.vmap(sparse_update_ops.take_rows)(table_h, ids_h)
+        if scale_h is not None:
+            miss_sc_h = jax.vmap(sparse_update_ops.take_rows)(scale_h,
+                                                              ids_h)
+            miss_rows_h = wire_ops.decode_rows(miss_rows_h, miss_sc_h,
+                                               bucket.storage_dtype)
     miss_rows = jax.device_put(miss_rows_h, dev_sh)        # [world, N, wf]
     rows = masked_two_source_gather(slots, slot_g, miss_rows)
     if combiner is None:

@@ -159,7 +159,6 @@ class InferenceEngine:
 
         emb = self.embedding
         self.caches: Dict[int, HotRowCache] = {}
-        bypassed: List[int] = []
         if emb._offload_enabled:
             off = [b for b, bk in enumerate(emb.plan.tp_buckets)
                    if bk.offload]
@@ -168,32 +167,16 @@ class InferenceEngine:
             else:
                 caps = {b: int(cache_capacity) for b in off}
             for b, cap in caps.items():
-                if cap > 0 and emb._bucket_store_dtype(b) != "f32":
-                    # quantized bucket (ISSUE 15): the cache has no
-                    # decode seam yet — serve through the stock
-                    # decode-at-gather lookup instead of refusing the
-                    # whole engine
-                    bypassed.append(b)
-                    continue
                 if cap > 0:
                     self.caches[b] = HotRowCache(
                         emb, b, cap, promote_threshold=promote_threshold)
-        if bypassed:
-            # ONE construction-time warning for the lot (ISSUE 16
-            # satellite): per-bucket warnings drowned in fleet-sized
-            # runs, and the unrealized capacity win was invisible to
-            # dashboards — the gauge makes it addressable
-            import warnings
-            warnings.warn(
-                f"serving cache skipped for quantized bucket(s) "
-                f"{bypassed}: they store "
-                f"{sorted({emb._bucket_store_dtype(b) for b in bypassed})} "
-                "rows and the cache has no decode seam; requests fall "
-                "back to the decoded host lookup "
-                "(serve/cache_bypassed_buckets counts them)",
-                RuntimeWarning, stacklevel=2)
+        # quantized buckets cache too now — the decode seam (ISSUE 17)
+        # stores decoded f32 rows in the slots. The gauge stays (its
+        # absence would read as "not measured" on dashboards that
+        # tracked the PR 16 bypass): constant 0 is the signal that
+        # every configured bucket is actually cached
         self._metrics.gauge("serve/cache_bypassed_buckets",
-                            **self._labels).set(len(bypassed))
+                            **self._labels).set(0)
         self._warmed: List[int] = []
         self._jit_fwd = jax.jit(
             self._fwd, donate_argnums=(1,) if donate_batch else ())
@@ -289,9 +272,16 @@ class InferenceEngine:
             slot_g = slot_map.get(g)
             if slot_g is None:
                 return None
+            # quantized buckets (ISSUE 17): fetch the scale leaf from
+            # the SAME traced params the payload came from, so the
+            # decode seam can never pair a payload with a stale scale
+            scale = (emb._bucket_scale(self._emb_params(params),
+                                       grp.bucket)
+                     if emb._bucket_store_dtype(grp.bucket) != "f32"
+                     else None)
             return cached_group_lookup(emb, grp, table,
                                        slots_map[grp.bucket], ids_g,
-                                       slot_g, w_g)
+                                       slot_g, w_g, scale_h=scale)
 
         with emb.offload_lookup_scope(hook):
             if self._model is None:
@@ -309,7 +299,12 @@ class InferenceEngine:
             if observe:
                 # admit on the counters accumulated so far, so this batch
                 # already hits rows that just crossed the threshold
-                cache.admit(emb_params["tp"][grp.bucket])
+                # (quantized buckets decode through the scale leaf)
+                cache.admit(emb_params["tp"][grp.bucket],
+                            scale=(emb._bucket_scale(emb_params,
+                                                     grp.bucket)
+                                   if emb._bucket_store_dtype(grp.bucket)
+                                   != "f32" else None))
             keys, valid = self._group_keys(grp, tp_prepped, target, true_rows)
             slot_map[g] = jnp.asarray(
                 cache.lookup_slots(keys, valid, observe=observe))

@@ -446,9 +446,9 @@ class TableStore:
         arr = self._params["tp"][b]
         w_idx = keys // rows_max
         r_idx = keys % rows_max
+        sd = self.emb._bucket_store_dtype(b)
         if self.emb._bucket_memory_kind(b):
             out = _np_rows_from_shards(arr, w_idx, r_idx)
-            sd = self.emb._bucket_store_dtype(b)
             if sd != "f32":
                 # quantized at-rest storage (ISSUE 15): the versioned
                 # read is ALWAYS decoded f32 — payload values (cast
@@ -457,6 +457,12 @@ class TableStore:
                     self._params["tp_scale"][b], w_idx, r_idx)
         else:
             out = padded_gather_rows(arr, w_idx, r_idx)
+            if sd != "f32":
+                # HBM-resident quantized buckets (ISSUE 17): payload
+                # codes gather losslessly through the f32 transit, so
+                # decode is the same multiply by the scale rows
+                out = out * padded_gather_rows(
+                    self._params["tp_scale"][b], w_idx, r_idx)
         overlay = self.emb.hot_resident_rows(self._params).get(b)
         if overlay is not None:
             okeys, orows = overlay                 # sorted by construction
@@ -689,9 +695,17 @@ class TableStore:
         sd = self.emb._bucket_store_dtype(b)
         if sd != "f32":
             payload, scale = wire_ops.encode_rows_np(rows, sd)
-            return (_host_set_rows(arr, w_idx, r_idx, payload),
-                    _host_set_rows(self._params["tp_scale"][b],
-                                   w_idx, r_idx, scale))
+            if self.emb._bucket_memory_kind(b):
+                return (_host_set_rows(arr, w_idx, r_idx, payload),
+                        _host_set_rows(self._params["tp_scale"][b],
+                                       w_idx, r_idx, scale))
+            # HBM-resident quantized bucket (ISSUE 17): payload codes
+            # transit the f32 scatter lanes exactly (ints on the int8
+            # grid / exact e4m3 values), `_scatter_rows` casts back to
+            # the stored dtype on write
+            return (padded_scatter_rows(arr, w_idx, r_idx, payload),
+                    padded_scatter_rows(self._params["tp_scale"][b],
+                                        w_idx, r_idx, scale))
         if self.emb._bucket_memory_kind(b):
             return _host_set_rows(arr, w_idx, r_idx,
                                   np.asarray(rows, np.float32)), None

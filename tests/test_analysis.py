@@ -400,7 +400,7 @@ def test_audit_driver_matrix_green_and_mutations_flag():
         "monolithic_f32", "monolithic_bf16", "vocab_slack_step",
         "monolithic_tiled", "pallas_strategy_step",
         "lookahead_prefetch", "lookahead_fused", "serve_forward",
-        "quantized_store_serve"}
+        "quantized_store_serve", "quantized_hbm_serve"}
     mrecords, mfailures = ha.run_mutations()
     assert mfailures == [], mfailures
     assert len(mrecords) == len(programs.mutation_cases())

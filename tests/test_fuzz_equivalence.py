@@ -68,14 +68,19 @@ def gen_config(seed):
         # configs — offloaded buckets, all-dp plans — skip the axis)
         kw["lookahead_axis"] = True
     if rng.rand() < 0.3:
-        # storage-dtype axis (ISSUE 15): quantized at-rest rows. The
-        # axis FORCES an offload budget so it always bites (the plan
-        # gate quantizes only offloaded buckets — without a budget the
-        # axis would be inert while still loosening the sweep's exact
-        # tolerances). One decode per offloaded gather + SR write-back
-        # per train step: the bf16-class tolerance covers it.
+        # storage-dtype axis (ISSUE 15 + 17): quantized at-rest rows on
+        # BOTH residencies. Half the draws force an offload budget
+        # (cold buckets: decode in the host exchange path); the other
+        # half leave whatever residency the config already drew — under
+        # the ISSUE 17 lifted gate device-resident buckets ALSO
+        # quantize, exercising the decode-at-gather branch inside the
+        # jitted forward. One decode per gather either way: the
+        # bf16-class tolerance covers it. (LookaheadEngine refuses
+        # quantized buckets, so that axis self-skips here.)
         kw["storage_dtype"] = "int8"
-        kw.setdefault("gpu_embedding_size", int(rng.choice([3000, 12000])))
+        if rng.rand() < 0.5:
+            kw.setdefault("gpu_embedding_size",
+                          int(rng.choice([3000, 12000])))
         kw.update(rtol=4e-2, atol=4e-2, train_rtol=4e-2, train_atol=4e-2)
     return specs, table_map, kw
 

@@ -512,11 +512,14 @@ def test_micro_batcher_replica_labels_coexist(std_dist):
     assert reg.histogram("serve/request_seconds").count == 0
 
 
-def test_quantized_bucket_cache_bypass_warns_and_gauges():
-    """ISSUE 16 satellite: quantized buckets have no cache decode seam —
-    the engine serves them through the decoded host lookup, says so ONCE
-    at construction, and publishes `serve/cache_bypassed_buckets` so the
-    unrealized capacity win is visible on dashboards."""
+def test_quantized_bucket_cache_decode_seam():
+    """ISSUE 17 satellite: quantized buckets cache through the decode
+    seam — the PR 16 bypass (and its RuntimeWarning) is gone, slots hold
+    DECODED f32 rows, cached serving bit-matches the stock
+    decode-at-gather host lookup, and `serve/cache_bypassed_buckets`
+    is pinned at 0."""
+    import warnings
+
     rng = np.random.RandomState(12)
     mesh = create_mesh(jax.devices()[:8])
     dist = DistributedEmbedding(
@@ -525,20 +528,31 @@ def test_quantized_bucket_cache_bypass_warns_and_gauges():
     quant = [b for b, bk in enumerate(dist.plan.tp_buckets)
              if bk.offload and bk.storage_dtype != "f32"]
     assert quant, "plan must quantize the offloaded bucket"
-    params = dist.set_weights(
-        [rng.randn(v, w).astype(np.float32) * 0.1 for v, w, _ in SPECS])
+    W = [rng.randn(v, w).astype(np.float32) * 0.1 for v, w, _ in SPECS]
+    params = dist.set_weights(W)
     reg = MetricRegistry()
-    with pytest.warns(RuntimeWarning, match="cache_bypassed_buckets"):
-        engine = InferenceEngine(dist, params, cache_capacity=256,
-                                 registry=reg)
-    assert not engine.caches            # nothing cacheable remained
-    assert reg.gauge("serve/cache_bypassed_buckets").value == len(quant)
-    # and the bypass really serves: predict works without a cache
-    cats = [_zipf(rng, v, 8) for v, _, _ in SPECS]
-    out = engine.predict(cats)
-    assert np.asarray(out[0]).shape[0] == 8
-    # f32 engines on the same registry report 0 (the healthy baseline)
-    reg2 = MetricRegistry()
-    dist2 = create_mesh  # noqa: F841 - keep line budget honest
-    eng2 = InferenceEngine(dist, params, cache_capacity=0, registry=reg2)
-    assert reg2.gauge("serve/cache_bypassed_buckets").value == 0
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")      # the bypass warning is GONE
+        engine = InferenceEngine(dist, params, cache_capacity=1024,
+                                 promote_threshold=1, registry=reg)
+    assert set(engine.caches) == set(quant)
+    assert reg.gauge("serve/cache_bypassed_buckets").value == 0
+    # slots are decoded f32 regardless of the at-rest payload dtype
+    cache = engine.caches[quant[0]]
+    assert cache.store_dtype == "int8"
+    assert cache.slots.dtype == jnp.float32
+    # cached serving bit-matches the uncached quantized host lookup —
+    # hit lanes (decoded slots) and miss lanes (decode in the host
+    # region) agree with the stock path's decode-at-gather numerics
+    uncached = jax.jit(lambda p, c: dist.apply(p, c))
+    for step in range(16):
+        cats = [_zipf(rng, v, BATCH) for v, _, _ in SPECS]
+        got = engine.predict(cats)
+        want = uncached(params, [jnp.asarray(c) for c in cats])
+        for i, (a, b) in enumerate(zip(want, got)):
+            np.testing.assert_array_equal(
+                np.asarray(b), np.asarray(a),
+                err_msg=f"step {step} output {i} diverged from host path")
+    stats = engine.cache_stats()
+    assert stats["hit_rate"] > 0.5, stats
+    assert stats["buckets"][0]["promotions"] > 0

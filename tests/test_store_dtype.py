@@ -36,19 +36,23 @@ from distributed_embeddings_tpu.training import make_sparse_train_step
 from test_dist_model_parallel import make_mesh
 
 # one big table past the per-rank budget (offloads -> quantizable) +
-# seven small device-resident ones (must stay f32 by the plan gate)
+# seven small ones. At BUDGET every table offloads into ONE cold bucket
+# (100x32 = 3200 > 3000); at MIXED_BUDGET the big table offloads and the
+# small ones stay HBM-resident in a second bucket — the two-residency
+# plan the ISSUE 17 lifted gate quantizes end to end.
 SPECS = [(4000, 32, "sum")] + [(100 + i, 32, "sum") for i in range(7)]
 BUDGET = 3000
+MIXED_BUDGET = 4000
 BATCH = 16
 
 QUANT_DTYPES = ["int8"] + (["fp8"] if wire_ops.fp8_supported() else [])
 
 
-def build(storage_dtype=None, specs=SPECS, **kw):
+def build(storage_dtype=None, specs=SPECS, budget=BUDGET, **kw):
     mesh = make_mesh(8)
     return DistributedEmbedding(
         [Embedding(v, w, combiner=c) for v, w, c in specs],
-        mesh=mesh, gpu_embedding_size=BUDGET,
+        mesh=mesh, gpu_embedding_size=budget,
         storage_dtype=storage_dtype, **kw)
 
 
@@ -114,15 +118,28 @@ def test_registries_and_byte_model():
 
 # ----------------------------------------------------- plan eligibility
 def test_plan_gate_and_f32_default(monkeypatch):
-    d8 = build("int8")
-    # only the offloaded bucket quantizes; device-resident buckets and
-    # row tables stay f32 regardless of the request
-    for b, bk in enumerate(d8.plan.tp_buckets):
-        assert bk.storage_dtype == ("int8" if bk.offload else "f32")
+    d8 = build("int8", budget=MIXED_BUDGET)
+    # ISSUE 17 lifted the offloaded-only gate: EVERY bucket quantizes —
+    # cold (host-offloaded) and HBM-resident alike — so the mixed plan
+    # holds both residencies in quantized form (the offloaded big table
+    # plus the device-resident small-table bucket)
+    assert all(bk.storage_dtype == "int8" for bk in d8.plan.tp_buckets)
+    assert any(bk.offload for bk in d8.plan.tp_buckets)
+    assert any(not bk.offload for bk in d8.plan.tp_buckets)
     assert all(rt.storage_dtype == "f32" for rt in d8.plan.row_tables)
-    assert d8.quantized_buckets == [b for b, bk in
-                                    enumerate(d8.plan.tp_buckets)
-                                    if bk.offload]
+    assert d8.quantized_buckets == list(range(len(d8.plan.tp_buckets)))
+    # every quantized bucket gets a scale leaf, device-resident included
+    p8 = d8.init(jax.random.PRNGKey(0))
+    for b in d8.quantized_buckets:
+        assert p8["tp"][b].dtype.itemsize == 1
+        assert p8["tp_scale"][b] is not None
+    # the one residual gate: a bucket with a hot shard stays f32 (hot
+    # write-back moves raw rows; re-encoding on membership change would
+    # re-quantize exactly the hottest rows, unbounded drift)
+    dh = build("int8", budget=MIXED_BUDGET, hot_rows=32)
+    assert any(bk.hot_rows > 0 for bk in dh.plan.tp_buckets)
+    for bk in dh.plan.tp_buckets:
+        assert bk.storage_dtype == ("f32" if bk.hot_rows > 0 else "int8")
     # default layer: no quantization anywhere, no scale leaf in params
     d32 = build(None)
     assert d32.quantized_buckets == []
@@ -165,14 +182,20 @@ def test_quantized_forward_parity_and_compile_count():
 @pytest.mark.parametrize("optimizer", ["sgd", "adagrad", "adam"])
 def test_train_convergence_parity_matrix(optimizer):
     """The per-optimizer convergence-bound parity matrix (the PR 5 wire
-    pattern): N steps through quantized offloaded storage track the f32
-    run within documented bounds — SR write-back, decode-at-gather, and
-    the f32 optimizer state all composed."""
+    pattern): N steps through quantized storage track the f32 run within
+    documented bounds. Under the ISSUE 17 lifted gate every bucket
+    quantizes, so sgd/adagrad exercise BOTH residencies at once — the
+    master-weight-free HBM row update (decode touched -> f32 math ->
+    hash-SR re-encode, no f32 shadow) on device buckets AND the
+    touched-rows host apply on the offloaded one. adam has no
+    master-weight-free rule: it must refuse LOUDLY on HBM-quantized
+    buckets, and its parity leg runs on an all-offloaded plan where the
+    host apply keeps f32 math end-to-end."""
     import jax.numpy as jnp
 
     class _M:
-        def __init__(self, sd):
-            self.embedding = build(sd)
+        def __init__(self, sd, budget=BUDGET):
+            self.embedding = build(sd, budget=budget)
 
         def loss_fn(self, p, numerical, cats, labels, taps=None,
                     return_residuals=False):
@@ -189,9 +212,28 @@ def test_train_convergence_parity_matrix(optimizer):
     num = jnp.zeros((BATCH, 1), jnp.float32)
     cats = rand_inputs(rng)
     lab = jnp.asarray(rng.randn(BATCH).astype(np.float32))
+    budget = MIXED_BUDGET
+    if optimizer == "adam":
+        # the loud refusal: HBM-resident quantized buckets under adam
+        m = _M("int8", budget=MIXED_BUDGET)
+        assert any(not m.embedding.plan.tp_buckets[b].offload
+                   for b in m.embedding.quantized_buckets)
+        init_fn, step_fn = make_sparse_train_step(m, "adam", lr=0.01,
+                                                  donate=False)
+        params = {"embedding": m.embedding.set_weights(W)}
+        state = init_fn(params)
+        with pytest.raises(NotImplementedError,
+                           match="master-weight-free"):
+            step_fn(params, state, num, cats, lab)
+        # parity leg: a budget of 1 offloads EVERY bucket, so adam's
+        # quantized path is the touched-rows host apply throughout
+        budget = 1
     runs = {}
     for sd in ["f32", "int8"]:
-        m = _M(sd)
+        m = _M(sd, budget=budget)
+        if optimizer == "adam" and sd == "int8":
+            assert all(m.embedding.plan.tp_buckets[b].offload
+                       for b in m.embedding.quantized_buckets)
         init_fn, step_fn = make_sparse_train_step(m, optimizer, lr=0.01,
                                                   donate=False)
         params = {"embedding": m.embedding.set_weights(W)}
@@ -274,13 +316,21 @@ def test_publish_consume_parity_and_byte_model(dtype, tmp_path):
 def test_quantized_table_storage_through_store_reads(tmp_path):
     """`read_rows` (THE versioned read) decodes quantized buckets; a
     consumed delta re-encodes into the quantized leaves and the next
-    read round-trips within one extra quantization step."""
+    read round-trips within one extra quantization step — on BOTH
+    residencies (the offloaded pinned-host bucket and an HBM-resident
+    one, whose payload/scale leaves stay on device through the device
+    gather/scatter path)."""
     from distributed_embeddings_tpu.store import TableStore
 
     rng = np.random.RandomState(11)
     W = rand_weights(rng)
-    emb = build("int8")
-    b0 = emb.quantized_buckets[0]
+    emb = build("int8", budget=MIXED_BUDGET)
+    off = [b for b in emb.quantized_buckets
+           if emb.plan.tp_buckets[b].offload]
+    hbm = [b for b in emb.quantized_buckets
+           if not emb.plan.tp_buckets[b].offload]
+    assert off and hbm, "lifted gate must quantize both residencies"
+    b0 = off[0]
     store = TableStore(emb, emb.set_weights(W))
     keys = np.arange(0, 64, dtype=np.int64)
     got = store.read_rows(b0, keys)
@@ -298,6 +348,113 @@ def test_quantized_table_storage_through_store_reads(tmp_path):
     got2 = store.read_rows(b0, keys[:8])
     b2 = float(wire_ops.store_decode_bound(new_rows, "int8").max())
     assert np.abs(got2 - new_rows).max() <= b2 + 1e-6
+    # HBM-resident bucket through the same seam: scatter lands i8
+    # payload + f32 scale on the device leaves, the next read decodes
+    bh = hbm[0]
+    kh = np.arange(0, 8, dtype=np.int64)
+    hr = rng.randn(8, 32).astype(np.float32) * 0.1
+    table_h, scale_h = store._apply_tp_rows(bh, kh, hr)
+    assert table_h.dtype.itemsize == 1
+    store._params["tp"][bh] = table_h
+    store._params["tp_scale"][bh] = scale_h
+    got3 = store.read_rows(bh, kh)
+    b3 = float(wire_ops.store_decode_bound(hr, "int8").max())
+    assert np.abs(got3 - hr).max() <= b3 + 1e-6
+
+
+def test_publish_consume_through_quantized_hbm_bucket(tmp_path):
+    """Store round trip where producer AND consumer hold HBM-resident
+    int8 buckets (ISSUE 17): the published snapshot+delta stream decodes
+    from the producer's quantized leaves and re-encodes into the
+    consumer's through the device scatter seam — the consumer's at-rest
+    payload stays 1-byte, and its decoded weights land within ONE RNE
+    quantization of the producer's decoded truth."""
+    from distributed_embeddings_tpu.store import TableStore, scan_published
+
+    rng = np.random.RandomState(23)
+    W = rand_weights(rng)
+    emb = build("int8", budget=MIXED_BUDGET)
+    assert any(not emb.plan.tp_buckets[b].offload
+               for b in emb.quantized_buckets)
+    store = TableStore(emb, emb.set_weights(W))
+    d = str(tmp_path / "hbm_stream")
+    store.publish(d)
+    ins = rand_inputs(rng)
+    store.observe(ins)
+    store.commit(store.params)
+    store.publish(d)
+    c_emb = build("int8", budget=MIXED_BUDGET)
+    con = TableStore(c_emb, c_emb.init(jax.random.PRNGKey(7)))
+    for _, _, path in scan_published(d):
+        con.apply_published(path)
+    for b in c_emb.quantized_buckets:
+        assert con._params["tp"][b].dtype.itemsize == 1
+        assert con._params["tp_scale"][b] is not None
+    for a, c in zip(store.get_weights(), con.get_weights()):
+        bound = float(wire_ops.store_decode_bound(a, "int8").max())
+        assert np.abs(a - c).max() <= bound + 1e-6
+
+
+def test_quantized_host_apply_moves_touched_rows_only():
+    """The offloaded quantized apply is O(touched rows), not O(bucket):
+    layer byte totals reconcile EXACTLY against `wire.delta_row_bytes` x
+    rows applied, the rows applied over a small working set stay far
+    below what whole-bucket re-encodes would move, and the
+    `store/quantized_rows_applied_total` counter mirrors the layer
+    total through the default registry."""
+    from distributed_embeddings_tpu.obs.registry import (
+        default_registry, reset_default_registry)
+    from distributed_embeddings_tpu.training import make_sparse_train_step
+
+    reset_default_registry()
+
+    class _M:
+        def __init__(self):
+            self.embedding = build("int8")
+
+        def loss_fn(self, p, numerical, cats, labels, taps=None,
+                    return_residuals=False):
+            out = self.embedding(p["embedding"], list(cats), taps=taps,
+                                 return_residuals=return_residuals)
+            outs, res = out if return_residuals else (out, None)
+            x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs],
+                                axis=1)
+            loss = jnp.mean((jnp.sum(x, axis=1) - labels.reshape(-1)) ** 2)
+            return (loss, res) if return_residuals else loss
+
+    rng = np.random.RandomState(29)
+    m = _M()
+    emb = m.embedding
+    off = [b for b in emb.quantized_buckets
+           if emb.plan.tp_buckets[b].offload]
+    assert off, "need an offloaded quantized bucket for the host apply"
+    capacity = sum(sum(emb.plan.tp_buckets[b].rows) for b in off)
+    init_fn, step_fn = make_sparse_train_step(m, "sgd", lr=0.01,
+                                              donate=False)
+    params = {"embedding": emb.set_weights(rand_weights(rng))}
+    state = init_fn(params)
+    num = jnp.zeros((BATCH, 1), jnp.float32)
+    lab = jnp.asarray(rng.randn(BATCH).astype(np.float32))
+    # a SMALL working set on the big (offloaded) table: 8 distinct ids
+    cats = rand_inputs(rng)
+    cats[0] = jnp.asarray(
+        rng.randint(0, 8, size=(BATCH, 2)).astype(np.int32))
+    steps = 3
+    for _ in range(steps):
+        params, state, _ = step_fn(params, state, num, cats, lab)
+    rows = emb.quantized_rows_applied_total
+    assert rows > 0
+    # EXACT byte reconciliation through the one shared formula
+    width = emb.plan.tp_buckets[off[0]].width
+    assert emb.quantized_apply_bytes_total == \
+        rows * wire_ops.delta_row_bytes(width, "int8")
+    # O(touched): the v1 whole-bucket roundtrip re-encodes `capacity`
+    # rows EVERY step; the touched-rows walk must move well under one
+    # such sweep across all steps combined (the harness replicates the
+    # batch per rank, so rows is ~ids x world, still << capacity)
+    assert rows < (steps * capacity) // 10, (rows, capacity)
+    assert default_registry().counter(
+        "store/quantized_rows_applied_total").value == rows
 
 
 # ----------------------------------------------------------- vocab stash
