@@ -78,47 +78,21 @@ def round_pin(traced_int: jax.Array) -> jax.Array:
             * jnp.float32(0.0))
 
 
-_MEASURED_DEFAULTS: Optional[dict] = None
-
-
 def measured_default(knob: str, fallback: str) -> str:
-    """Hardware-measured default for a DET_* dispatch knob.
+    """Resolved default for a DET_* dispatch knob.
 
-    bench.py's A/B arms write the winning knob values (with provenance) to
-    tools/measured_defaults.json when they win on the real chip — decision
-    rule 5 of docs/perf_model.md executed by machinery instead of a human
-    editing defaults. Env vars always override; the file is consulted ONLY
-    on the TPU backend (CPU test equivalence must not silently change when
-    a TPU bench has run on the same checkout), and a missing/invalid file
-    (e.g. an installed wheel with no tools/ dir) means `fallback`.
-    DET_MEASURED_DEFAULTS_CONSULT=1 forces the file read off-TPU — the
-    unattended-window rehearsal's knob (tools/window_rehearsal.py), which
-    must verify on CPU that a written flip actually changes this
-    function's output before the flip machinery runs unattended on
-    hardware."""
-    env = os.environ.get(knob)
-    if env is not None:
-        return env
-    if (jax.default_backend() != "tpu"
-            and os.environ.get("DET_MEASURED_DEFAULTS_CONSULT") != "1"):
-        return fallback
-    global _MEASURED_DEFAULTS
-    if _MEASURED_DEFAULTS is None:
-        import json
-        path = os.environ.get(
-            "DET_MEASURED_DEFAULTS_PATH",
-            os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__)))), "tools",
-                "measured_defaults.json"))
-        try:
-            with open(path) as f:
-                raw = json.load(f)
-            _MEASURED_DEFAULTS = {
-                k: (v.get("value") if isinstance(v, dict) else v)
-                for k, v in raw.items()}
-        except Exception:  # noqa: BLE001 - absent/invalid file = no flips
-            _MEASURED_DEFAULTS = {}
-    return _MEASURED_DEFAULTS.get(knob, fallback)
+    Thin delegate to ``tune.resolve.knob_value`` (ISSUE 18), which owns
+    the resolution order: env var > the workload's config-of-record
+    ``tools/tuned/<workload>.json`` (explicit opt-in via
+    DET_TUNED_WORKLOAD / DET_TUNED_PATH, written by ``bench.py --mode
+    tune``) > ``tools/measured_defaults.json`` (the PR-2 hardware-A/B
+    writer, TPU backend only — CPU test equivalence must not silently
+    change when a TPU bench has run on the same checkout;
+    DET_MEASURED_DEFAULTS_CONSULT=1 forces the read off-TPU for the
+    window rehearsal) > ``fallback``. Every tuned/measured adoption
+    leaves a ``tune/adopt`` flight-recorder event."""
+    from ..tune import resolve as _tune_resolve
+    return _tune_resolve.knob_value(knob, fallback)
 
 
 def _dedup_impl() -> str:

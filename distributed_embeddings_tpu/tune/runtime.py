@@ -1,0 +1,153 @@
+"""Online adaptation for the runtime-flippable knob class (stretch).
+
+The SLO evaluator (obs/slo.py) already turns degraded operation into
+typed findings and postmortems; this module lets it also trigger a
+MITIGATION — but only over knobs the registry marks ``safety ==
+"runtime"`` (publish cadence, snapshot cadence, admission limits,
+admission thresholds). Offline knobs (wire dtypes, kernel dispatch,
+lookahead...) change the lowered program and are refused at
+construction: an auto-flip there would be a silent re-plan.
+
+Every flip is bounded (multiplicative step clamped to the rule's
+[min, max]), rate-limited (one flip per knob per ``react`` call plus a
+cooldown of ``cooldown_reacts`` calls), and leaves a
+``tune/autoflip`` flight-recorder instant + a
+``tune/autoflips_total{knob=}`` counter — the same audit discipline as
+every tuned-value adoption. The tuner NEVER writes env vars or config
+files: it calls the applier the owner registered (e.g. a closure over
+``AdmissionController.max_queue_depth``), so the flip is visible,
+typed, and revertible by the owning subsystem.
+"""
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from . import registry as _registry
+
+# Default reaction rules, matched by substring against finding ids
+# (obs/slo.py emits `slo:<rule-name>` / degraded reasons). Shipped
+# conservative: shed harder under queue pressure, publish less under
+# stream distress — both runtime-class, both instantly revertible.
+DEFAULT_RULES = (
+    {"match": "queue", "knob": "DET_FLEET_MAX_QUEUE_DEPTH",
+     "action": "scale", "factor": 0.5, "min": 4, "max": 4096},
+    {"match": "publish", "knob": "DET_PUBLISH_EVERY",
+     "action": "scale", "factor": 2.0, "min": 1, "max": 256},
+)
+
+
+class RuntimeTuner:
+    """Map SLO/degraded findings to bounded runtime-knob adjustments.
+
+    Args:
+      appliers: ``{env: callable(int_value)}`` — the owner-side setter
+        for each knob this tuner may touch. Every env must name a
+        registry knob with ``safety == "runtime"`` (ValueError
+        otherwise — the registry is the safety authority, not the
+        caller).
+      initial: ``{env: int}`` current values; a knob without one starts
+        from its registry fallback (empty fallback = knob unusable
+        until a value is provided).
+      rules: reaction rules (see DEFAULT_RULES); each must name an env
+        present in ``appliers``.
+      cooldown_reacts: after a flip, the knob sits out this many
+        subsequent ``react`` calls — mitigation, not oscillation.
+    """
+
+    def __init__(self, appliers: Dict[str, Callable],
+                 initial: Optional[Dict[str, int]] = None,
+                 rules: Sequence[dict] = DEFAULT_RULES,
+                 cooldown_reacts: int = 2,
+                 recorder=None, registry=None):
+        self._appliers = dict(appliers)
+        for env in self._appliers:
+            k = _registry.get_knob(env)       # KeyError on unknown
+            if k.safety != _registry.RUNTIME:
+                raise ValueError(
+                    f"knob {env} is {k.safety}-only: a runtime flip "
+                    "would silently change the lowered program — "
+                    "offline knobs are the search harness's, not the "
+                    "RuntimeTuner's")
+        self._rules = [dict(r) for r in rules
+                       if r.get("knob") in self._appliers]
+        for r in self._rules:
+            if r.get("action") != "scale":
+                raise ValueError(f"unknown rule action {r.get('action')!r}")
+        self._values: Dict[str, int] = {}
+        for env in self._appliers:
+            fb = _registry.get_knob(env).fallback
+            if (initial or {}).get(env) is not None:
+                self._values[env] = int(initial[env])
+            elif fb != "":
+                self._values[env] = int(fb)
+        self._cooldown = int(cooldown_reacts)
+        self._sitting_out: Dict[str, int] = {}   # env -> reacts left
+        self._recorder = recorder
+        self._registry = registry
+        self.flips: List[dict] = []              # full history, appended
+
+    def _record_flip(self, flip: dict) -> None:
+        self.flips.append(flip)
+        try:
+            rec = self._recorder
+            if rec is None:
+                from ..obs.trace import default_recorder
+                rec = default_recorder()
+            rec.instant("tune/autoflip", **flip)
+        except Exception:  # noqa: BLE001 - audit must not break serving
+            pass
+        try:
+            reg = self._registry
+            if reg is None:
+                from ..obs.registry import default_registry
+                reg = default_registry()
+            reg.counter("tune/autoflips_total", knob=flip["knob"]).inc()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def react(self, findings) -> List[dict]:
+        """One mitigation pass over SLO findings (obs.slo Finding objects
+        or dicts with an ``id``/``fid``). Returns the flips applied this
+        call (each ``{knob, from, to, finding}``); knobs in cooldown or
+        already at their rule bound flip nothing."""
+        ids = []
+        for f in findings or ():
+            fid = getattr(f, "fid", None) or getattr(f, "id", None)
+            if fid is None and isinstance(f, dict):
+                fid = f.get("fid") or f.get("id")
+            if fid:
+                ids.append(str(fid))
+        applied: List[dict] = []
+        flipped_now = set()
+        # age existing cooldowns AFTER the skip check below uses them:
+        # a knob flipped on react N sits out reacts N+1..N+cooldown
+        cooled = {env: left - 1 for env, left in self._sitting_out.items()
+                  if left > 1}
+        skip_now = set(self._sitting_out)
+        self._sitting_out = cooled
+        for rule in self._rules:
+            env = rule["knob"]
+            if env in skip_now or env in flipped_now:
+                continue
+            hit = next((i for i in ids if rule["match"] in i), None)
+            if hit is None or env not in self._values:
+                continue
+            cur = self._values[env]
+            new = int(round(cur * float(rule["factor"])))
+            if new == cur:
+                new = cur + (1 if rule["factor"] > 1 else -1)
+            new = max(int(rule.get("min", 1)),
+                      min(int(rule.get("max", new)), new))
+            if new == cur:
+                continue                       # already at the bound
+            self._appliers[env](new)
+            self._values[env] = new
+            if self._cooldown > 0:
+                self._sitting_out[env] = self._cooldown
+            flipped_now.add(env)
+            flip = {"knob": env, "from": cur, "to": new, "finding": hit}
+            self._record_flip(flip)
+            applied.append(flip)
+        return applied
+
+    def value(self, env: str) -> Optional[int]:
+        return self._values.get(env)

@@ -3,7 +3,12 @@
 bench.py persists winning A/B knob values to tools/measured_defaults.json
 (decision rule 5, docs/perf_model.md); the dispatch reads them as the
 TPU-backend default. Env always overrides; CPU backends never consult the
-file (test equivalence must not change because a TPU bench ran)."""
+file (test equivalence must not change because a TPU bench ran).
+
+Since ISSUE 18 `measured_default` delegates to `tune.resolve.knob_value`
+(env > tuned config > measured defaults > fallback) — the tests here
+cover the measured-defaults layer and the bench writer; the tuned layer
+is tests/test_tune.py's."""
 
 import json
 
@@ -11,6 +16,7 @@ import jax
 import pytest
 
 from distributed_embeddings_tpu.ops import sparse_update
+from distributed_embeddings_tpu.tune import resolve as tune_resolve
 
 
 @pytest.fixture
@@ -22,9 +28,11 @@ def defaults_file(tmp_path, monkeypatch):
         "DET_DEDUP_IMPL": "cumsum",          # bare-string form accepted
     }))
     monkeypatch.setenv("DET_MEASURED_DEFAULTS_PATH", str(path))
-    monkeypatch.setattr(sparse_update, "_MEASURED_DEFAULTS", None)
+    monkeypatch.delenv("DET_TUNED_PATH", raising=False)
+    monkeypatch.delenv("DET_TUNED_WORKLOAD", raising=False)
+    tune_resolve.reset_cache()
     yield path
-    monkeypatch.setattr(sparse_update, "_MEASURED_DEFAULTS", None)
+    tune_resolve.reset_cache()
 
 
 def test_env_overrides_file(defaults_file, monkeypatch):
@@ -55,10 +63,10 @@ def test_missing_file_falls_back(tmp_path, monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     monkeypatch.setenv("DET_MEASURED_DEFAULTS_PATH",
                        str(tmp_path / "nope.json"))
-    monkeypatch.setattr(sparse_update, "_MEASURED_DEFAULTS", None)
+    tune_resolve.reset_cache()
     monkeypatch.delenv("DET_SCATTER_IMPL", raising=False)
     assert sparse_update.measured_default("DET_SCATTER_IMPL", "xla") == "xla"
-    monkeypatch.setattr(sparse_update, "_MEASURED_DEFAULTS", None)
+    tune_resolve.reset_cache()
 
 
 def _load_bench():
@@ -165,16 +173,24 @@ def test_bench_writer_requires_margin(tmp_path, monkeypatch):
     assert "measured_defaults_written" not in rec
 
 
-def test_bench_isolation_pins_reader(monkeypatch):
+def test_bench_isolation_pins_reader(tmp_path, monkeypatch):
     """_isolate_from_measured_defaults points the in-process reader at an
-    unparsable path and drops the cache, so the bench's baseline arms can
-    never be contaminated by an earlier flip."""
+    unparsable path, drops BOTH tuned selectors and resets the resolve
+    caches, so the bench's baseline arms can never be contaminated by an
+    earlier flip — measured-defaults OR a prior --mode tune record
+    (ISSUE 18)."""
     import os
     bench = _load_bench()
     monkeypatch.setenv("DET_MEASURED_DEFAULTS_PATH", "/tmp/whatever.json")
+    tuned = tmp_path / "tuned.json"
+    tuned.write_text("{}")
+    monkeypatch.setenv("DET_TUNED_PATH", str(tuned))
+    monkeypatch.setenv("DET_TUNED_WORKLOAD", "dlrm")
     bench._isolate_from_measured_defaults()
     assert os.environ["DET_MEASURED_DEFAULTS_PATH"] == os.devnull
+    assert "DET_TUNED_PATH" not in os.environ
+    assert "DET_TUNED_WORKLOAD" not in os.environ
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     monkeypatch.delenv("DET_SCATTER_IMPL", raising=False)
     assert sparse_update.measured_default("DET_SCATTER_IMPL", "xla") == "xla"
-    monkeypatch.setattr(sparse_update, "_MEASURED_DEFAULTS", None)
+    tune_resolve.reset_cache()

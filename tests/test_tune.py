@@ -1,0 +1,498 @@
+"""The tune subsystem (ISSUE 18): registry, resolution seam, offline
+search machinery, runtime tuner, and the seams that consume them.
+
+Covers the acceptance spine: `measured_default()`/`knob_value()` resolve
+through env > tuned config-of-record > measured defaults > fallback with
+flight-recorder adoption events; a malformed/stale tuned file falls
+through LOUDLY (warning + counter) and never crashes dispatch; the
+search prunes with a full audit trail; the RuntimeTuner only ever flips
+runtime-safety knobs; and the generated docs knob table cannot drift
+from the registry."""
+
+import json
+import os
+import warnings
+
+import jax
+import pytest
+
+from distributed_embeddings_tpu.obs.registry import default_registry
+from distributed_embeddings_tpu.obs.trace import default_recorder
+from distributed_embeddings_tpu.tune import registry as tune_registry
+from distributed_embeddings_tpu.tune import resolve as tune_resolve
+from distributed_embeddings_tpu.tune import runtime as tune_runtime
+from distributed_embeddings_tpu.tune import search as tune_search
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_resolution(monkeypatch):
+    """Every test resolves from a clean slate: no tuned selectors, no
+    measured-defaults file, empty caches."""
+    monkeypatch.delenv("DET_TUNED_PATH", raising=False)
+    monkeypatch.delenv("DET_TUNED_WORKLOAD", raising=False)
+    monkeypatch.delenv("DET_MEASURED_DEFAULTS_CONSULT", raising=False)
+    monkeypatch.setenv("DET_MEASURED_DEFAULTS_PATH", os.devnull)
+    tune_resolve.reset_cache()
+    yield
+    tune_resolve.reset_cache()
+
+
+def _tuned_doc(winner, workload="dlrm", **extra):
+    """A minimal schema-valid tuned-config-v1 doc."""
+    doc = {
+        "schema": tune_search.TUNED_SCHEMA, "workload": workload,
+        "created_at": "2026-08-07T00:00:00Z", "git_sha": "abc123",
+        "backend": "cpu", "winner": dict(winner),
+        "arms": [{"key": "defaults", "overrides": {}, "step_ms": 1.0}],
+        "pruned": [], "prune_order": ["collective_bytes"],
+        "prune_audit_ok": True, "beats_default": {},
+        "staged_tpu_arms": [],
+    }
+    doc.update(extra)
+    return doc
+
+
+# ------------------------------------------------------------- registry
+
+def test_registry_invariants():
+    knobs = tune_registry.all_knobs()
+    assert len({k.env for k in knobs}) == len(knobs)
+    assert len({k.name for k in knobs}) == len(knobs)
+    for k in knobs:
+        assert k.is_legal(k.fallback), k.name
+        assert k.safety in (tune_registry.OFFLINE, tune_registry.RUNTIME)
+        # lookup by either handle
+        assert tune_registry.get_knob(k.name) is k
+        assert tune_registry.get_knob(k.env) is k
+
+
+def test_registry_covers_the_scattered_call_sites():
+    """The knobs the library actually reads must all be registry-owned —
+    the registry is only a single source of truth if it is COMPLETE."""
+    for env in ("DET_SCATTER_IMPL", "DET_LOOKUP_PATH", "DET_DEDUP_IMPL",
+                "DET_EXCHANGE_WIRE", "DET_ID_WIRE", "DET_STORE_DTYPE",
+                "DET_DELTA_DTYPE", "DET_HOT_ROWS", "DET_LOOKAHEAD",
+                "DET_PIPELINE_DEPTH", "DET_PUBLISH_EVERY",
+                "DET_STORE_SNAPSHOT_EVERY", "DET_VOCAB_ADMIT",
+                "DET_FLEET_MAX_QUEUE_DEPTH", "DET_FLEET_MAX_QUEUE_ROWS"):
+        tune_registry.get_knob(env)      # KeyError = registry hole
+
+
+def test_registry_unknown_knob_raises():
+    with pytest.raises(KeyError):
+        tune_registry.get_knob("DET_NOT_A_KNOB")
+    assert tune_registry.maybe_get("DET_NOT_A_KNOB") is None
+
+
+def test_validate_override():
+    assert tune_registry.validate_override("DET_SCATTER_IMPL",
+                                           "tiled") is None
+    assert "illegal value" in tune_registry.validate_override(
+        "DET_SCATTER_IMPL", "warp")
+    assert "unknown knob" in tune_registry.validate_override(
+        "DET_NOPE", "x")
+    assert "STRINGS" in tune_registry.validate_override(
+        "DET_HOT_ROWS", 5)
+    # int-domain bounds
+    assert tune_registry.validate_override("DET_HOT_ROWS", "0") is None
+    assert "illegal value" in tune_registry.validate_override(
+        "DET_HOT_ROWS", "-1")
+    # unset-able open-domain knob: "" legal only where fallback is ""
+    assert tune_registry.validate_override(
+        "DET_FLEET_MAX_QUEUE_ROWS", "") is None
+    assert "illegal value" in tune_registry.validate_override(
+        "DET_PIPELINE_DEPTH", "")
+
+
+def test_dedup_is_numerics_class():
+    """The cumsum trade stays a human decision — the registry class the
+    whole no-auto-flip policy keys off."""
+    assert tune_registry.get_knob(
+        "DET_DEDUP_IMPL").parity == tune_registry.PARITY_NUMERICS
+
+
+# ------------------------------------------------------------ resolution
+
+def test_precedence_env_beats_tuned(tmp_path, monkeypatch):
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(_tuned_doc(
+        {"DET_SCATTER_IMPL": "tiled"})))
+    monkeypatch.setenv("DET_TUNED_PATH", str(path))
+    monkeypatch.setenv("DET_SCATTER_IMPL", "pallas")
+    tune_resolve.reset_cache()
+    assert tune_resolve.knob_value("DET_SCATTER_IMPL", "xla") == "pallas"
+
+
+def test_tuned_beats_measured_and_fallback(tmp_path, monkeypatch):
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(_tuned_doc(
+        {"DET_SCATTER_IMPL": "tiled"})))
+    measured = tmp_path / "m.json"
+    measured.write_text(json.dumps({"DET_SCATTER_IMPL": "pallas",
+                                    "DET_LOOKUP_PATH": "tiled"}))
+    monkeypatch.setenv("DET_TUNED_PATH", str(path))
+    monkeypatch.setenv("DET_MEASURED_DEFAULTS_PATH", str(measured))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    tune_resolve.reset_cache()
+    # tuned layer wins over the measured file...
+    assert tune_resolve.knob_value("DET_SCATTER_IMPL", "xla") == "tiled"
+    # ...and a knob absent from the tuned winner falls to measured
+    assert tune_resolve.knob_value("DET_LOOKUP_PATH", "auto") == "tiled"
+    # ...and a knob in neither bottoms out at the fallback
+    assert tune_resolve.knob_value("DET_EXCHANGE_WIRE", "f32") == "f32"
+
+
+def test_tuned_consulted_on_cpu_only_with_explicit_env(tmp_path,
+                                                       monkeypatch):
+    """No DET_TUNED_* env -> no tuned consult, ANY backend: CPU test
+    equivalence cannot silently change because a tuner ran."""
+    assert jax.default_backend() == "cpu"
+    assert tune_resolve.knob_value("DET_SCATTER_IMPL", "xla") == "xla"
+    # with the env, the tuned layer applies on CPU too (explicit opt-in)
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(_tuned_doc({"DET_SCATTER_IMPL": "tiled"})))
+    monkeypatch.setenv("DET_TUNED_PATH", str(path))
+    tune_resolve.reset_cache()
+    assert tune_resolve.knob_value("DET_SCATTER_IMPL", "xla") == "tiled"
+
+
+def test_tuned_workload_name_resolves_repo_path(monkeypatch):
+    monkeypatch.setenv("DET_TUNED_WORKLOAD", "dlrm")
+    path, workload = tune_resolve.tuned_source()
+    assert workload == "dlrm"
+    assert path == os.path.join(REPO_ROOT, "tools", "tuned", "dlrm.json")
+
+
+def test_malformed_tuned_file_falls_through_loudly(tmp_path, monkeypatch):
+    """Warning + counter + fallback — never a crash (satellite 3)."""
+    path = tmp_path / "t.json"
+    path.write_text("{not json")
+    monkeypatch.setenv("DET_TUNED_PATH", str(path))
+    tune_resolve.reset_cache()
+    before = default_registry().snapshot()["counters"].get(
+        "tune/tuned_config_invalid_total", 0)
+    with pytest.warns(RuntimeWarning, match="malformed/stale"):
+        assert tune_resolve.knob_value("DET_SCATTER_IMPL",
+                                       "xla") == "xla"
+    after = default_registry().snapshot()["counters"].get(
+        "tune/tuned_config_invalid_total", 0)
+    assert after == before + 1
+    # the warning fires ONCE per process per file, not per knob read
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert tune_resolve.knob_value("DET_LOOKUP_PATH",
+                                       "auto") == "auto"
+
+
+def test_stale_schema_tuned_file_falls_through(tmp_path, monkeypatch):
+    doc = _tuned_doc({"DET_SCATTER_IMPL": "tiled"})
+    doc["schema"] = "tuned-config-v0"          # a future/old format
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(doc))
+    monkeypatch.setenv("DET_TUNED_PATH", str(path))
+    tune_resolve.reset_cache()
+    with pytest.warns(RuntimeWarning):
+        assert tune_resolve.knob_value("DET_SCATTER_IMPL",
+                                       "xla") == "xla"
+    assert tune_resolve.tuned_info()["errors"]
+
+
+def test_workload_mismatch_refuses(tmp_path, monkeypatch):
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(_tuned_doc({"DET_SCATTER_IMPL": "tiled"},
+                                          workload="serve")))
+    monkeypatch.setenv("DET_TUNED_PATH", str(path))
+    monkeypatch.setenv("DET_TUNED_WORKLOAD", "dlrm")
+    tune_resolve.reset_cache()
+    with pytest.warns(RuntimeWarning, match="workload mismatch"):
+        assert tune_resolve.knob_value("DET_SCATTER_IMPL",
+                                       "xla") == "xla"
+
+
+def test_illegal_winner_entry_rejected_individually(tmp_path,
+                                                    monkeypatch):
+    """One bad entry is dropped (counter + warning); the legal rest of
+    the winner still applies."""
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(_tuned_doc({
+        "DET_SCATTER_IMPL": "warp-drive",       # illegal value
+        "DET_LOOKUP_PATH": "tiled",             # legal
+    })))
+    monkeypatch.setenv("DET_TUNED_PATH", str(path))
+    tune_resolve.reset_cache()
+    before = default_registry().snapshot()["counters"].get(
+        "tune/tuned_knob_rejected_total", 0)
+    with pytest.warns(RuntimeWarning, match="rejected"):
+        assert tune_resolve.knob_value("DET_SCATTER_IMPL",
+                                       "xla") == "xla"
+    assert tune_resolve.knob_value("DET_LOOKUP_PATH", "auto") == "tiled"
+    after = default_registry().snapshot()["counters"].get(
+        "tune/tuned_knob_rejected_total", 0)
+    assert after == before + 1
+
+
+def test_adoption_emits_flight_recorder_event(tmp_path, monkeypatch):
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(_tuned_doc({"DET_SCATTER_IMPL": "tiled"})))
+    monkeypatch.setenv("DET_TUNED_PATH", str(path))
+    tune_resolve.reset_cache()
+    assert tune_resolve.knob_value("DET_SCATTER_IMPL", "xla") == "tiled"
+    evs = [e for e in default_recorder().events()
+           if e[0] == "i" and e[1] == "tune/adopt"
+           and (e[6] or {}).get("knob") == "DET_SCATTER_IMPL"]
+    assert evs, "tuned adoption left no tune/adopt instant"
+    assert evs[-1][6]["value"] == "tiled"
+    assert evs[-1][6]["source"].startswith("tuned:")
+    counters = default_registry().snapshot()["counters"]
+    assert counters.get('tune/adoptions_total{source=tuned}', 0) >= 1
+
+
+def test_measured_default_delegates(monkeypatch):
+    """ops.sparse_update.measured_default is the same seam (historical
+    entry point, PR-2 signature preserved)."""
+    from distributed_embeddings_tpu.ops import sparse_update
+    monkeypatch.setenv("DET_SCATTER_IMPL", "tiled")
+    assert sparse_update.measured_default("DET_SCATTER_IMPL",
+                                          "xla") == "tiled"
+
+
+# --------------------------------------------------------------- search
+
+def test_enumerate_arms_validates_and_dedups():
+    arms = tune_search.enumerate_arms(
+        {"DET_EXCHANGE_WIRE": ["f32", "bf16"],
+         "DET_ID_WIRE": ["auto", "int32"]})
+    assert arms[0].key == "defaults"
+    # baseline (f32, auto) equals one product point -> deduped: 1 + 3
+    assert len(arms) == 4
+    with pytest.raises(KeyError):
+        tune_search.enumerate_arms({"DET_NOPE": ["x"]})
+    with pytest.raises(ValueError, match="search space"):
+        tune_search.enumerate_arms({"DET_EXCHANGE_WIRE": ["f64"]})
+
+
+def test_prune_by_cost_logs_every_pruned_arm():
+    arms = tune_search.enumerate_arms(
+        {"DET_EXCHANGE_WIRE": ["f32", "bf16", "bf16-sr"],
+         "DET_ID_WIRE": ["auto", "int32"]})
+    bytes_for = {"f32": 100.0, "bf16": 50.0, "bf16-sr": 50.0}
+
+    def cost(arm):
+        b = bytes_for[arm.overrides["DET_EXCHANGE_WIRE"]]
+        if arm.overrides["DET_ID_WIRE"] == "int32":
+            b += 10.0
+        return {"collective_bytes": b}
+
+    kept, pruned, audit_ok = tune_search.prune_by_cost(
+        arms, cost, keep=2, order=("collective_bytes",))
+    assert audit_ok
+    assert len(kept) + len(pruned) == len(arms)
+    # the baseline survives even at the worst predicted cost
+    assert any(a.key == "defaults" for a in kept)
+    # every pruned arm carries its predicted costs and a rationale
+    for p in pruned:
+        assert p["rationale"] and "predicted" in p and p["overrides"]
+    # no silent caps: nothing dropped without a log line
+    assert {p["arm"] for p in pruned} | {a.key for a in kept} \
+        == {a.key for a in arms}
+
+
+def test_prune_audit_flags_ordering_violation():
+    """A cost_fn that lies between calls (non-deterministic ranking)
+    must be caught by the ordering audit, not shipped."""
+    arms = [tune_search.Arm({"DET_EXCHANGE_WIRE": "f32"}, key="a"),
+            tune_search.Arm({"DET_EXCHANGE_WIRE": "bf16"}, key="b"),
+            tune_search.Arm({"DET_EXCHANGE_WIRE": "bf16-sr"}, key="c")]
+    kept, pruned, audit_ok = tune_search.prune_by_cost(
+        arms, lambda a: {"x": 1.0}, keep=1, order=("x",),
+        always_keep=("zzz",))
+    # all ranks equal: ties never violate ordering
+    assert audit_ok
+
+    # force a violation: always_keep pins the WORST arm while a cheaper
+    # one is pruned -> that is fine (forced); but a kept non-forced arm
+    # ranking above a pruned one is a bug
+    costs = {"a": 3.0, "b": 1.0, "c": 2.0}
+    kept, pruned, audit_ok = tune_search.prune_by_cost(
+        arms, lambda arm: {"x": costs[arm.key]}, keep=2, order=("x",),
+        always_keep=())
+    assert audit_ok
+    assert [a.key for a in kept] == ["b", "c"]
+    assert pruned[0]["arm"] == "a"
+
+
+def test_split_adoptable():
+    adoptable, staged = tune_search.split_adoptable({
+        "DET_SCATTER_IMPL": "tiled",      # exact -> adoptable
+        "DET_EXCHANGE_WIRE": "bf16",      # bounded -> staged
+        "DET_ID_WIRE": "auto",            # equals fallback -> adoptable
+        "DET_DEDUP_IMPL": "cumsum",       # numerics -> staged
+    })
+    assert adoptable == {"DET_SCATTER_IMPL": "tiled",
+                         "DET_ID_WIRE": "auto"}
+    assert staged == {"DET_EXCHANGE_WIRE": "bf16",
+                      "DET_DEDUP_IMPL": "cumsum"}
+
+
+def test_record_round_trip_and_validator():
+    doc = tune_search.build_record(
+        workload="dlrm", winner={"DET_SCATTER_IMPL": "tiled"},
+        arms=[{"key": "defaults", "overrides": {}, "step_ms": 2.0},
+              {"key": "scatter_impl=tiled",
+               "overrides": {"DET_SCATTER_IMPL": "tiled"},
+               "step_ms": 1.0}],
+        pruned=[{"arm": "x", "overrides": {}, "predicted": {},
+                 "rationale": "outside keep"}],
+        prune_order=["collective_bytes"], prune_audit_ok=True,
+        beats_default={"collective_bytes": True},
+        staged_tpu_arms=[], git_sha="abc", backend="cpu",
+        created_at="2026-08-07T00:00:00Z")
+    assert tune_search.validate_tuned_record(doc) == []
+    # round trip through JSON stays valid
+    assert tune_search.validate_tuned_record(
+        json.loads(json.dumps(doc))) == []
+
+    # the writer refuses to emit an invalid record
+    with pytest.raises(ValueError, match="invalid tuned record"):
+        tune_search.build_record(
+            workload="dlrm", winner={}, arms=[], pruned=[],
+            prune_order=[], prune_audit_ok=True, beats_default={},
+            staged_tpu_arms=[], git_sha="abc", backend="cpu",
+            created_at="2026-08-07T00:00:00Z")
+
+    # validator failure shapes
+    assert tune_search.validate_tuned_record("nope")
+    bad = dict(doc, schema="v0")
+    assert any("stale or foreign" in e
+               for e in tune_search.validate_tuned_record(bad))
+    bad = dict(doc, prune_audit_ok=False)
+    assert any("ordering audit" in e
+               for e in tune_search.validate_tuned_record(bad))
+    bad = dict(doc, pruned=[{"arm": "x"}])
+    assert any("rationale" in e
+               for e in tune_search.validate_tuned_record(bad))
+
+
+# -------------------------------------------------------- runtime tuner
+
+def test_runtime_tuner_refuses_offline_knobs():
+    with pytest.raises(ValueError, match="offline"):
+        tune_runtime.RuntimeTuner(
+            {"DET_EXCHANGE_WIRE": lambda v: None},
+            rules=[{"match": "x", "knob": "DET_EXCHANGE_WIRE",
+                    "action": "scale", "factor": 2.0}])
+
+
+def test_runtime_tuner_flips_bounded_with_events():
+    applied_values = []
+    tuner = tune_runtime.RuntimeTuner(
+        {"DET_FLEET_MAX_QUEUE_DEPTH": applied_values.append},
+        initial={"DET_FLEET_MAX_QUEUE_DEPTH": 64},
+        cooldown_reacts=1)
+    flips = tuner.react([{"id": "slo:queue_depth_p99"}])
+    assert flips == [{"knob": "DET_FLEET_MAX_QUEUE_DEPTH",
+                      "from": 64, "to": 32,
+                      "finding": "slo:queue_depth_p99"}]
+    assert applied_values == [32]
+    assert tuner.value("DET_FLEET_MAX_QUEUE_DEPTH") == 32
+    # cooldown: the immediate next react flips nothing
+    assert tuner.react([{"id": "slo:queue_depth_p99"}]) == []
+    # after the cooldown expires it may flip again, bounded below
+    assert tuner.react([{"id": "slo:queue_depth_p99"}])[0]["to"] == 16
+    # audit trail: flight-recorder instants + counter
+    evs = [e for e in default_recorder().events()
+           if e[0] == "i" and e[1] == "tune/autoflip"]
+    assert len(evs) >= 2
+    counters = default_registry().snapshot()["counters"]
+    assert counters.get(
+        "tune/autoflips_total{knob=DET_FLEET_MAX_QUEUE_DEPTH}", 0) >= 2
+    assert len(tuner.flips) == 2
+
+
+def test_runtime_tuner_respects_bounds():
+    tuner = tune_runtime.RuntimeTuner(
+        {"DET_FLEET_MAX_QUEUE_DEPTH": lambda v: None},
+        initial={"DET_FLEET_MAX_QUEUE_DEPTH": 4},
+        rules=[{"match": "queue", "knob": "DET_FLEET_MAX_QUEUE_DEPTH",
+                "action": "scale", "factor": 0.5, "min": 4, "max": 4096}],
+        cooldown_reacts=0)
+    # already at the rule's floor: no flip, no event
+    assert tuner.react([{"id": "slo:queue"}]) == []
+    assert tuner.value("DET_FLEET_MAX_QUEUE_DEPTH") == 4
+
+
+def test_runtime_tuner_slo_finding_objects():
+    """Accepts obs.slo Finding-shaped objects (fid attribute), not just
+    dicts — the evaluator's native output."""
+    class _F:
+        fid = "slo:publish_lag"
+
+    seen = []
+    tuner = tune_runtime.RuntimeTuner(
+        {"DET_PUBLISH_EVERY": seen.append},
+        initial={"DET_PUBLISH_EVERY": 4})
+    flips = tuner.react([_F()])
+    assert flips and flips[0]["to"] == 8 and seen == [8]
+
+
+# ---------------------------------------------------- docs + scenarios
+
+def test_perf_model_knob_table_matches_registry():
+    """docs/perf_model.md embeds the GENERATED knob table — drift between
+    the registry and the doc fails here, with the regeneration command
+    in the assertion message."""
+    path = os.path.join(REPO_ROOT, "docs", "perf_model.md")
+    with open(path) as f:
+        text = f.read()
+    begin, end = "<!-- knob-table:begin -->", "<!-- knob-table:end -->"
+    assert begin in text and end in text, \
+        "docs/perf_model.md lost its knob-table markers"
+    embedded = text.split(begin)[1].split(end)[0].strip()
+    expected = tune_registry.knob_table_markdown().strip()
+    assert embedded == expected, (
+        "docs/perf_model.md knob table drifted from the registry — "
+        "regenerate with `python -m distributed_embeddings_tpu.tune."
+        "registry` and paste between the markers")
+
+
+def test_checked_in_scenarios_lint_clean():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "det_lint", os.path.join(REPO_ROOT, "tools",
+                                 "lint_invariants.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    findings = lint.lint_scenario_knobs()
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_scenario_lint_catches_bad_knobs(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "det_lint", os.path.join(REPO_ROOT, "tools",
+                                 "lint_invariants.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    (tmp_path / "bad.json").write_text(json.dumps(
+        {"name": "bad", "knobs": {"DET_NOPE": "x",
+                                  "DET_DEDUP_IMPL": "zzz"}}))
+    (tmp_path / "good.json").write_text(json.dumps(
+        {"name": "good", "knobs": {"DET_DEDUP_IMPL": "sort"}}))
+    (tmp_path / "broken.json").write_text("{not json")
+    findings = lint.lint_scenario_knobs(str(tmp_path))
+    msgs = "\n".join(str(f) for f in findings)
+    assert "unknown knob 'DET_NOPE'" in msgs
+    assert "illegal value" in msgs
+    assert "unparsable" in msgs
+    assert len(findings) == 3
+
+
+def test_fit_env_knobs_resolve_through_seam(monkeypatch):
+    """DET_PIPELINE_DEPTH / DET_PUBLISH_EVERY land through knob_value
+    (tuned-config adoptable); the explicit argument still wins."""
+    monkeypatch.setenv("DET_PIPELINE_DEPTH", "5")
+    assert int(tune_resolve.knob_value("DET_PIPELINE_DEPTH", "2")) == 5
+    monkeypatch.delenv("DET_PIPELINE_DEPTH")
+    assert int(tune_resolve.knob_value("DET_PIPELINE_DEPTH", "2")) == 2

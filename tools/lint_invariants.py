@@ -34,6 +34,16 @@ tests/test_lint_invariants.py):
                      and ``collections.Counter`` stays untouched (only
                      names imported from the metric modules count).
 
+  scenario-knobs     every ``"knobs"`` override in a checked-in
+                     ``tools/soak_scenarios/*.json`` scenario names a
+                     tune-registry knob with a legal value (ISSUE 18) —
+                     the same validation ``bench.load_soak_scenario``
+                     enforces at load, moved up to CI so a typo'd env
+                     var or out-of-domain value is flagged at review,
+                     not on the soak host. JSON rule: runs whenever the
+                     default file set is linted (no per-line escape —
+                     fix the scenario).
+
 Escapes: append ``# lint: allow(<rule>)`` to the offending line (or the
 line directly above). Escapes are themselves greppable, which is the
 point — an allowed violation is a reviewed decision, not an accident.
@@ -252,6 +262,47 @@ def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
     return findings
 
 
+def lint_scenario_knobs(scenario_dir: Optional[str] = None
+                        ) -> List[Finding]:
+    """Validate every scenario file's ``"knobs"`` overrides against the
+    tune registry (ISSUE 18). A scenario naming an unknown env var or an
+    out-of-domain value would refuse at `bench.load_soak_scenario` —
+    this rule surfaces it in CI instead. An unparsable scenario file is
+    itself a finding (the soak host would hit the same wall)."""
+    if scenario_dir is None:
+        scenario_dir = os.path.join(REPO_ROOT, "tools", "soak_scenarios")
+    sys.path.insert(0, REPO_ROOT)
+    from distributed_embeddings_tpu.tune import registry as tune_registry
+    findings: List[Finding] = []
+    if not os.path.isdir(scenario_dir):
+        return findings
+    for name in sorted(os.listdir(scenario_dir)):
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(scenario_dir, name)
+        rel = _rel(path)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except ValueError as e:
+            findings.append(Finding("scenario-knobs", rel, 1,
+                                    f"unparsable scenario JSON: {e}"))
+            continue
+        knobs = doc.get("knobs")
+        if knobs is None:
+            continue
+        if not isinstance(knobs, dict):
+            findings.append(Finding(
+                "scenario-knobs", rel, 1,
+                "'knobs' must be an env -> value object"))
+            continue
+        for env, value in knobs.items():
+            err = tune_registry.validate_override(env, value)
+            if err is not None:
+                findings.append(Finding("scenario-knobs", rel, 1, err))
+    return findings
+
+
 def default_files() -> List[str]:
     out = []
     for dirpath, dirnames, filenames in os.walk(
@@ -273,6 +324,10 @@ def main(argv=None) -> int:
     findings: List[Finding] = []
     for path in files:
         findings.extend(lint_file(path))
+    if not args.paths:
+        # the JSON scenario rule rides the default sweep (explicit
+        # paths mean "lint exactly these python files")
+        findings.extend(lint_scenario_knobs())
     if args.json:
         print(json.dumps([f.to_dict() for f in findings], indent=1))
     else:

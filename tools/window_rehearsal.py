@@ -1,29 +1,27 @@
 """End-to-end CPU rehearsal of the unattended hardware-window pipeline.
 
-VERDICT r5 weak #5: the round's whole plan rides on watcher-recovery →
-quickab → bench → measured_defaults.json write → dispatch flip firing
-correctly in a single unattended window, and the composed sequence had run
-zero times — "a plumbing bug discovered DURING the first real window is the
-single most expensive failure mode available". This script executes the
-same composition on the CPU backend, tiny shapes, asserting each stage's
-artifact:
+VERDICT r5 weak #5: the window's plan rides on search → config-of-record
+write → dispatch flip firing correctly in a single unattended window,
+and a plumbing bug discovered DURING the first real window is the single
+most expensive failure mode available. Since ISSUE 18 the whole
+measure→decide loop lives in ONE place — ``bench.py --mode tune`` — so
+this script is a thin wrapper over it rather than a second copy of the
+stage list and knob choreography it used to carry:
 
-  1. stage-runner: the claim watcher's `cmd:timeout:name` stage loop
-     (claim_watch_r05.sh) over a rehearsal stage list — quickab first
-     (DET_QUICKAB_ALLOW_CPU=1, shrunken batch), then the full bench
-     (DET_BENCH_FORCE_CPU=1). Asserts each stage exits 0 and leaves its
-     JSON artifact, exactly like `tools/watch_<name>_r05.out`.
-  2. defaults-writer: the REAL `bench._maybe_write_measured_defaults`
-     (DET_BENCH_ALLOW_CPU_DEFAULTS_WRITE=1) against a scratch defaults
-     path, fed the real bench record with synthetic winning tiled margins
-     (marked `rehearsal_synthetic_arms`; CPU cannot produce real tiled
-     wins). Asserts the knob values + provenance land in the file.
-  3. dispatch flip: a FRESH python process with
-     DET_MEASURED_DEFAULTS_CONSULT=1 pointed at the scratch file asserts
-     `measured_default()` output actually changed (and stays the fallback
-     without the file) — the end the whole pipeline exists to reach.
+  1. run ``bench.py --mode tune --rehearse`` (CPU backend, tiny shapes,
+     scratch output dir) and assert the emitted record: schema-valid
+     tuned-config-v1 via the REAL validator, prune-ordering audit green,
+     a non-empty prune log (no silent caps), and >= 2 measured arms
+     including the defaults baseline.
+  2. dispatch flip: a FRESH python process pointed at the record via
+     ``DET_TUNED_PATH`` asserts ``measured_default()`` output actually
+     changed (and stays the fallback without it) — the end the whole
+     pipeline exists to reach. CPU arms cannot genuinely win, so the
+     flip check runs against a copy of the real record grafted with a
+     synthetic winner (marked ``rehearsal_synthetic_winner``); what is
+     rehearsed is the READER seam, not the CPU's timing verdict.
 
-Writes tools/window_rehearsal_cpu.log (the committed green-log artifact)
+Writes tools/window_rehearsal_cpu.out (the committed green-log artifact)
 and prints one JSON line. Exit 0 = every stage green.
 """
 
@@ -41,19 +39,7 @@ sys.path.insert(0, ROOT)
 # watch_<stage>_r05.out files) and *.log is gitignored
 LOG_PATH = os.path.join(ROOT, "tools", "window_rehearsal_cpu.out")
 
-# the watcher's stage format, verbatim (cmd:timeout_secs:name) — parsing
-# and dispatch below mirror claim_watch_r05.sh's loop
-REHEARSAL_STAGES = """\
-tools/quick_tiled_ab.py:1500:quickab
-bench.py:1700:bench
-"""
-
-STAGE_ENV = {
-    "quickab": {"DET_QUICKAB_ALLOW_CPU": "1", "DET_QUICKAB_BATCH": "256",
-                "DET_QUICKAB_ITERS": "2", "JAX_PLATFORMS": "cpu"},
-    "bench": {"DET_BENCH_FORCE_CPU": "1", "DET_BENCH_INNER": "1",
-              "DET_BENCH_SKIP_BUSY_WAIT": "1"},
-}
+TUNE_TIMEOUT_S = 1700
 
 
 class _Log:
@@ -67,138 +53,119 @@ class _Log:
         self.f.flush()
 
 
-def run_stages(log, outdir):
-    """The claim watcher's stage loop, rehearsed: one killable subprocess
-    per `cmd:timeout:name` line, artifact to watch_<name>_rehearsal.out."""
-    records = {}
-    for line in REHEARSAL_STAGES.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        cmd, secs, name = line.rsplit(":", 2)
-        art = os.path.join(outdir, f"watch_{name}_rehearsal.out")
-        env = dict(os.environ, **STAGE_ENV.get(name, {}))
-        log.line(f"running {name} ({cmd}, timeout {secs}s)")
-        t0 = time.perf_counter()
-        with open(art, "w") as f:
-            p = subprocess.run([sys.executable, "-u"] + cmd.split(),
-                               stdout=f, stderr=subprocess.STDOUT,
-                               timeout=int(secs), env=env, cwd=ROOT)
-        wall = time.perf_counter() - t0
-        log.line(f"{name} rc={p.returncode} wall={wall:.0f}s -> {art}")
-        assert p.returncode == 0, f"stage {name} failed (rc={p.returncode})"
-        # artifact contract: at least one JSON line, like the watcher's
-        # grep '"metric"' gate on the bench stage
-        json_lines = []
-        with open(art) as f:
-            for ln in f:
-                if ln.startswith("{"):
-                    try:
-                        json_lines.append(json.loads(ln))
-                    except ValueError:
-                        pass
-        assert json_lines, f"stage {name} left no JSON artifact in {art}"
-        records[name] = json_lines[-1]
-    assert "tiny_default_ms" in records["quickab"], records["quickab"]
-    assert "metric" in records["bench"] and "value" in records["bench"], (
-        records["bench"])
-    assert not records["bench"].get("cached"), (
-        "bench stage emitted a CACHED record during rehearsal")
-    return records
+def run_tune_rehearsal(log, outdir):
+    """One ``bench.py --mode tune --rehearse`` subprocess; returns the
+    emitted record after asserting the artifact contract."""
+    art = os.path.join(outdir, "watch_tune_rehearsal.out")
+    env = dict(os.environ, DET_BENCH_FORCE_CPU="1", JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-u", "bench.py", "--mode", "tune",
+           "--rehearse", "--out", outdir]
+    log.line(f"running tune ({' '.join(cmd[2:])}, "
+             f"timeout {TUNE_TIMEOUT_S}s)")
+    t0 = time.perf_counter()
+    with open(art, "w") as f:
+        p = subprocess.run(cmd, stdout=f, stderr=subprocess.STDOUT,
+                           timeout=TUNE_TIMEOUT_S, env=env, cwd=ROOT)
+    wall = time.perf_counter() - t0
+    log.line(f"tune rc={p.returncode} wall={wall:.0f}s -> {art}")
+    assert p.returncode == 0, f"tune stage failed (rc={p.returncode})"
+    records = []
+    with open(art) as f:
+        for ln in f:
+            if ln.startswith("{"):
+                try:
+                    records.append(json.loads(ln))
+                except ValueError:
+                    pass
+    assert records, f"tune stage left no JSON artifact in {art}"
+    record = records[-1]
+    assert record.get("rehearsal") is True, record.get("metric")
+    assert not record.get("tune_error"), record["tune_error"]
+    return record
 
 
-def rehearse_defaults_write(log, bench_record, defaults_path):
-    """Run the real measured-defaults writer against a scratch path.
+def check_record(log, record):
+    """Assert the config-of-record through the REAL reader-side
+    validator, plus the evidence-trail gates the CI tune smoke uses."""
+    from distributed_embeddings_tpu.tune import search as tune_search
 
-    CPU arms cannot genuinely win, so the margins rule is fed synthetic
-    winning tiled arms grafted onto the real record — marked as such. What
-    is being rehearsed is the WRITER: margin arithmetic, provenance
-    fields, file shape, and the flip surface the reader consumes."""
-    import importlib.util
-    spec = importlib.util.spec_from_file_location(
-        "det_bench", os.path.join(ROOT, "bench.py"))
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
-
-    record = dict(bench_record)
-    record.update({
-        "rehearsal_synthetic_arms": True,
-        "tiny_best_path": "tiled-fwd+bwd",
-        "dlrm_best_path": "tiled-fwd+bwd",
-        "tiny_ab_default_ms": 100.0, "tiny_ab_tiled_ms": 50.0,
-        "tiny_ab_tiled_full_ms": 40.0,
-        "dlrm_ab_sort_ms": 100.0, "dlrm_ab_tiled_ms": 60.0,
-        "dlrm_ab_tiled_full_ms": 55.0,
-    })
-    os.environ["DET_BENCH_ALLOW_CPU_DEFAULTS_WRITE"] = "1"
-    try:
-        bench._MEASURED_DEFAULTS_PATH = defaults_path
-        bench._maybe_write_measured_defaults(record)
-    finally:
-        os.environ.pop("DET_BENCH_ALLOW_CPU_DEFAULTS_WRITE", None)
-    assert record.get("measured_defaults_written") == {
-        "DET_SCATTER_IMPL": "tiled", "DET_LOOKUP_PATH": "tiled"}, (
-        f"writer did not flip both knobs: "
-        f"{record.get('measured_defaults_written')}")
-    with open(defaults_path) as f:
-        data = json.load(f)
-    for knob in ("DET_SCATTER_IMPL", "DET_LOOKUP_PATH"):
-        assert data[knob]["value"] == "tiled", data
-        assert "git_sha" in data[knob] and "evidence" in data[knob], data
-        margins = data[knob]["evidence"]["margins"]
-        assert all(m is not None and m >= 1.03 for m in margins.values()), (
-            f"writer flipped on sub-threshold margins: {margins}")
-    log.line(f"defaults write OK -> {defaults_path} "
-             f"({sorted(data)} with provenance)")
-    return data
+    path = record.get("tuned_path")
+    assert path and os.path.exists(path), f"no config-of-record at {path}"
+    with open(path) as f:
+        doc = json.load(f)
+    errors = tune_search.validate_tuned_record(doc)
+    assert not errors, f"schema-invalid record: {errors}"
+    assert doc["prune_audit_ok"] is True, "prune-ordering audit failed"
+    assert doc["pruned"], "empty prune log: the search never pruned " \
+        "anything, or pruned silently"
+    measured = [a for a in doc["arms"] if "step_ms" in a]
+    assert len(measured) >= 2, f"need >= 2 measured arms, have " \
+        f"{[a['key'] for a in measured]}"
+    assert any(a["key"] == "defaults" for a in measured), \
+        "defaults baseline was not measured"
+    log.line(f"record OK -> {path} (winner={doc['winner']}, "
+             f"{len(measured)} measured, {len(doc['pruned'])} pruned, "
+             f"{len(doc['staged_tpu_arms'])} staged TPU arm(s))")
+    return doc
 
 
-def rehearse_dispatch_flip(log, defaults_path):
-    """Assert the written file changes measured_default() output in a fresh
-    process (the reader caches per process), and that WITHOUT the file the
-    fallback still rules — both directions of the flip."""
+def rehearse_dispatch_flip(log, doc, outdir):
+    """Assert a tuned record changes measured_default() output in a
+    fresh process via DET_TUNED_PATH, and that without it the fallback
+    still rules — both directions of the flip. The copy under test
+    grafts a synthetic winner (CPU cannot genuinely win tiled arms);
+    the READER seam is what is being rehearsed."""
+    flip_doc = dict(doc)
+    flip_doc["winner"] = {"DET_SCATTER_IMPL": "tiled"}
+    flip_doc["rehearsal_synthetic_winner"] = True
+    flip_path = os.path.join(outdir, "flip_rehearsal.json")
+    with open(flip_path, "w") as f:
+        json.dump(flip_doc, f)
     code = (
         "import os, sys\n"
-        "os.environ['DET_MEASURED_DEFAULTS_CONSULT'] = '1'\n"
         "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
         f"sys.path.insert(0, {ROOT!r})\n"
         "from distributed_embeddings_tpu.ops import sparse_update as su\n"
-        "impl = su.measured_default('DET_SCATTER_IMPL', 'xla')\n"
-        "path = su.measured_default('DET_LOOKUP_PATH', 'auto')\n"
-        "print(impl, path)\n"
+        "print(su.measured_default('DET_SCATTER_IMPL', 'xla'))\n"
     )
-    for path, want in ((defaults_path, "tiled tiled"),
-                       (os.devnull, "xla auto")):
-        env = dict(os.environ, DET_MEASURED_DEFAULTS_PATH=path)
+    for tuned_path, want in ((flip_path, "tiled"), (None, "xla")):
+        env = dict(os.environ)
+        env.pop("DET_TUNED_PATH", None)
+        env.pop("DET_TUNED_WORKLOAD", None)
+        env.pop("DET_SCATTER_IMPL", None)
+        if tuned_path is not None:
+            env["DET_TUNED_PATH"] = tuned_path
         p = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True, timeout=300,
                            env=env, cwd=ROOT)
         got = p.stdout.strip().splitlines()[-1] if p.stdout.strip() else ""
         assert p.returncode == 0 and got == want, (
-            f"flip check against {path}: want {want!r}, got {got!r} "
-            f"(rc={p.returncode}, stderr={p.stderr[-300:]})")
-    log.line("dispatch flip OK: measured_default() = tiled with the file, "
-             "fallback without")
+            f"flip check with DET_TUNED_PATH={tuned_path}: want {want!r}, "
+            f"got {got!r} (rc={p.returncode}, stderr={p.stderr[-300:]})")
+    log.line("dispatch flip OK: measured_default() = tiled with the "
+             "record, fallback without")
 
 
 def main() -> int:
     log = _Log(LOG_PATH)
-    log.line("window rehearsal start (CPU backend, tiny shapes)")
-    summary = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    log.line("window rehearsal start (CPU backend, tiny shapes, "
+             "--mode tune --rehearse)")
+    summary = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime())}
     t0 = time.perf_counter()
     with tempfile.TemporaryDirectory(prefix="det_rehearsal_") as outdir:
-        records = run_stages(log, outdir)
-        defaults_path = os.path.join(outdir, "measured_defaults.json")
-        data = rehearse_defaults_write(log, records["bench"], defaults_path)
-        rehearse_dispatch_flip(log, defaults_path)
+        record = run_tune_rehearsal(log, outdir)
+        doc = check_record(log, record)
+        rehearse_dispatch_flip(log, doc, outdir)
         summary.update({
-            "stages": sorted(records),
-            "quickab_tiny_default_ms": records["quickab"].get(
-                "tiny_default_ms"),
-            "bench_metric": records["bench"].get("metric"),
-            "bench_value_ms": records["bench"].get("value"),
-            "bench_hlo_sort_audit": records["bench"].get("hlo_sort_audit"),
-            "defaults_knobs_written": sorted(data),
+            "stages": ["tune"],
+            "tune_workload": doc["workload"],
+            "tune_winner": doc["winner"],
+            "tune_measured_arms": sum(1 for a in doc["arms"]
+                                      if "step_ms" in a),
+            "tune_pruned": len(doc["pruned"]),
+            "tune_prune_audit_ok": doc["prune_audit_ok"],
+            "tune_staged_tpu_arms": len(doc["staged_tpu_arms"]),
             "flip_verified": True,
             "wall_s": round(time.perf_counter() - t0, 1),
         })
